@@ -1,0 +1,51 @@
+"""Logging (reference: include/LightGBM/utils/log.h).
+
+The reference has a static ``Log`` class with Fatal/Warning/Info/Debug levels
+driven by the ``verbosity`` parameter plus CHECK macros.  Here we route through
+the stdlib logging module under the ``lightgbm_tpu`` logger, keeping the same
+level semantics (verbose<0: fatal only, 0: +warning, 1: +info, >1: +debug).
+"""
+from __future__ import annotations
+
+import logging
+
+_logger = logging.getLogger("lightgbm_tpu")
+if not _logger.handlers:
+    _handler = logging.StreamHandler()
+    _handler.setFormatter(logging.Formatter("[LightGBM-TPU] [%(levelname)s] %(message)s"))
+    _logger.addHandler(_handler)
+    _logger.setLevel(logging.INFO)
+
+
+def set_verbosity(verbose: int) -> None:
+    if verbose < 0:
+        _logger.setLevel(logging.CRITICAL)
+    elif verbose == 0:
+        _logger.setLevel(logging.WARNING)
+    elif verbose == 1:
+        _logger.setLevel(logging.INFO)
+    else:
+        _logger.setLevel(logging.DEBUG)
+
+
+def log_fatal(msg: str) -> None:
+    _logger.critical(msg)
+    raise RuntimeError(msg)
+
+
+def log_warning(msg: str) -> None:
+    _logger.warning(msg)
+
+
+def log_info(msg: str) -> None:
+    _logger.info(msg)
+
+
+def log_debug(msg: str) -> None:
+    _logger.debug(msg)
+
+
+def check(cond: bool, msg: str = "") -> None:
+    """CHECK macro equivalent (reference utils/log.h:22-34)."""
+    if not cond:
+        log_fatal(f"Check failed: {msg}")

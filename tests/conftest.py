@@ -1,0 +1,15 @@
+"""Test harness config: run all tests on a virtual 8-device CPU mesh.
+
+Mirrors the survey's recommendation (SURVEY.md §4): the reference cannot
+test multi-node in-repo; we can, by forcing
+``xla_force_host_platform_device_count=8`` so shard_map-based distributed
+tree learners run as real 8-way SPMD programs on CPU.
+"""
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (
+        flags + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")

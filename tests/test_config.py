@@ -1,0 +1,62 @@
+import pytest
+
+from lightgbm_tpu.config import Config, canonicalize_params
+
+
+def test_alias_resolution():
+    p = canonicalize_params({"num_boost_round": 50})
+    assert p["num_iterations"] == 50
+    p = canonicalize_params({"reg_alpha": 0.5, "reg_lambda": 1.0,
+                             "min_child_samples": 5, "colsample_bytree": 0.8})
+    assert p == {"lambda_l1": 0.5, "lambda_l2": 1.0,
+                 "min_data_in_leaf": 5, "feature_fraction": 0.8}
+
+
+def test_canonical_wins_over_alias():
+    p = canonicalize_params({"num_iterations": 10, "num_round": 99})
+    assert p["num_iterations"] == 10
+
+
+def test_config_defaults():
+    cfg = Config.from_params({})
+    assert cfg.num_leaves == 31
+    assert cfg.learning_rate == 0.1
+    assert cfg.max_bin == 255
+    assert cfg.boosting_type == "gbdt"
+    assert cfg.objective == "regression"
+
+
+def test_config_objective_aliases():
+    assert Config.from_params({"objective": "mse"}).objective == "regression"
+    assert Config.from_params({"objective": "mae"}).objective == "regression_l1"
+    assert Config.from_params({"application": "binary"}).objective == "binary"
+    assert Config.from_params(
+        {"objective": "multiclass", "num_class": 3}).objective == "multiclass"
+
+
+def test_config_type_coercion():
+    cfg = Config.from_params({"num_leaves": "63", "learning_rate": "0.05",
+                              "is_unbalance": "true", "metric": "auc,binary_logloss",
+                              "ndcg_eval_at": "1,3,5"})
+    assert cfg.num_leaves == 63
+    assert cfg.learning_rate == 0.05
+    assert cfg.is_unbalance is True
+    assert cfg.metric == ("auc", "binary_logloss")
+    assert cfg.ndcg_eval_at == (1, 3, 5)
+
+
+def test_config_conflicts():
+    with pytest.raises(ValueError):
+        Config.from_params({"num_leaves": 1})
+    with pytest.raises(ValueError):
+        Config.from_params({"objective": "multiclass"})  # num_class missing
+    with pytest.raises(ValueError):
+        Config.from_params({"boosting": "rf"})  # needs bagging
+    with pytest.raises(ValueError):
+        Config.from_params({"boosting": "goss", "top_rate": 0.8, "other_rate": 0.5})
+
+
+def test_num_tree_per_iteration():
+    cfg = Config.from_params({"objective": "multiclass", "num_class": 4})
+    assert cfg.num_tree_per_iteration == 4
+    assert Config.from_params({}).num_tree_per_iteration == 1
