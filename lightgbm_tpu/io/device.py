@@ -1,0 +1,57 @@
+"""Device-resident dataset: the HBM binned matrix + static feature metadata.
+
+The TPU analog of the reference's in-memory ``Dataset`` handed to tree
+learners (`/root/reference/include/LightGBM/dataset.h:280-578`): one dense
+``[n, F]`` integer array plus flat per-feature metadata arrays, all ready
+to be sharded over a ``jax.sharding.Mesh`` data axis by the distributed
+learners.
+"""
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from .dataset import BinnedDataset
+
+
+class DeviceData(NamedTuple):
+    """Static-shape training data pytree (device arrays + static ints)."""
+    bins: jnp.ndarray           # [n, F] uint8/int32
+    bin_offsets: jnp.ndarray    # [F] int32 offsets into flat bin space
+    num_bins: jnp.ndarray       # [F] int32 (includes NaN bin)
+    default_bins: jnp.ndarray   # [F] int32 (bin of value 0.0)
+    missing_types: jnp.ndarray  # [F] int32
+    is_categorical: jnp.ndarray  # [F] bool
+    nan_bins: jnp.ndarray       # [F] int32 (num_bins-1 where NaN else -1)
+    total_bins: int             # static
+    max_bins: int               # static
+    has_categorical: bool = True   # static: lets the split scan drop cat work
+
+    @property
+    def num_data(self) -> int:
+        return self.bins.shape[0]
+
+    @property
+    def num_features(self) -> int:
+        return self.bins.shape[1]
+
+
+def to_device(ds: BinnedDataset) -> DeviceData:
+    info = ds.feature_info
+    from .binning import MISSING_NAN
+    nan_bins = np.where(info.missing_types == MISSING_NAN,
+                        info.num_bins - 1, -1).astype(np.int32)
+    return DeviceData(
+        bins=jnp.asarray(ds.bins),
+        bin_offsets=jnp.asarray(info.bin_offsets[:-1], jnp.int32),
+        num_bins=jnp.asarray(info.num_bins, jnp.int32),
+        default_bins=jnp.asarray(info.default_bins, jnp.int32),
+        missing_types=jnp.asarray(info.missing_types, jnp.int32),
+        is_categorical=jnp.asarray(info.is_categorical),
+        nan_bins=jnp.asarray(nan_bins),
+        total_bins=int(info.total_bins),
+        max_bins=int(info.max_num_bins),
+        has_categorical=bool(info.is_categorical.any()),
+    )

@@ -1,0 +1,309 @@
+"""Serial (single-shard) tree learner — one jitted wave-growth loop.
+
+TPU-native redesign of the reference ``SerialTreeLearner``
+(`/root/reference/src/treelearner/serial_tree_learner.cpp:155-622`).  The
+reference grows leaf-wise: a sequential best-first loop that, per split,
+builds the smaller child's histograms (OpenMP over feature groups), derives
+the sibling by subtraction, scans features for the best split, and
+physically repartitions row indices (`data_partition.hpp`).
+
+Here the whole tree is built by ONE ``lax.while_loop`` of *waves*:
+
+  1. one histogram pass for ALL current leaves (``build_histograms`` —
+     a single scatter keyed by the row→leaf vector; no data partition,
+     no histogram pool, no ordered bins),
+  2. one vectorized split search for all leaves × features
+     (``find_best_splits``),
+  3. split the top-``wave_size`` leaves by gain in the same wave.
+
+``wave_size=1`` reproduces the reference's leaf-wise growth decision-for-
+decision (one best-gain leaf per wave).  ``wave_size>=num_leaves`` splits
+every positive-gain leaf per wave — ~log2(num_leaves) histogram passes per
+tree instead of num_leaves−1, the TPU-friendly default (the histogram pass
+costs O(n·F) regardless of how many leaves it serves, so batching splits
+divides the dominant cost by the wave width).
+
+Everything is static-shape: leaf arrays are sized ``[num_leaves]``, tree
+node arrays ``[num_leaves-1]``, and finished trees report a dynamic
+``num_leaves`` scalar.  The same step runs unchanged under ``shard_map``
+for the distributed learners (histograms gain a ``psum``).
+"""
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ..io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+from ..io.device import DeviceData
+from ..ops.histogram import build_histograms, pad_to_feature_grid
+from ..ops.split import SplitParams, SplitResult, find_best_splits
+
+NEG_INF = -1e30
+
+
+class GrowthParams(NamedTuple):
+    """Static tree-growth parameters."""
+    num_leaves: int = 31
+    max_depth: int = -1
+    wave_size: int = 0          # 0 => unlimited (full wave); 1 => leaf-wise
+    split: SplitParams = SplitParams()
+
+
+class BuiltTree(NamedTuple):
+    """A finished tree as device arrays (fixed shapes, dynamic num_leaves).
+
+    Node layout matches the reference Tree (`tree.h`): internal nodes
+    ``[0, num_leaves-2]``, children ``>=0`` internal / ``~leaf`` for leaves.
+    """
+    feature: jnp.ndarray         # [L-1] i32 (used-column index)
+    threshold_bin: jnp.ndarray   # [L-1] i32
+    default_left: jnp.ndarray    # [L-1] bool
+    is_categorical: jnp.ndarray  # [L-1] bool
+    cat_mask: jnp.ndarray        # [L-1, B] bool  (bins going left)
+    left_child: jnp.ndarray      # [L-1] i32
+    right_child: jnp.ndarray     # [L-1] i32
+    gain: jnp.ndarray            # [L-1] f32
+    internal_value: jnp.ndarray  # [L-1] f32 (parent leaf output)
+    internal_count: jnp.ndarray  # [L-1] i32
+    leaf_value: jnp.ndarray      # [L] f32
+    leaf_count: jnp.ndarray      # [L] i32
+    leaf_depth: jnp.ndarray      # [L] i32
+    num_leaves: jnp.ndarray      # scalar i32
+    row_leaf: jnp.ndarray        # [n] i32 final leaf per row (ALL rows)
+
+
+class _WaveState(NamedTuple):
+    row_leaf: jnp.ndarray        # [n] leaf per row (all rows, incl. out-of-bag)
+    hist_leaf: jnp.ndarray       # [n] leaf per row or -1 (out-of-bag)
+    nl: jnp.ndarray              # scalar i32 current leaf count
+    done: jnp.ndarray            # scalar bool
+    leaf_sum_grad: jnp.ndarray   # [L]
+    leaf_sum_hess: jnp.ndarray   # [L]
+    leaf_count: jnp.ndarray      # [L] f32 (in-bag counts)
+    leaf_depth: jnp.ndarray      # [L] i32
+    leaf_value: jnp.ndarray      # [L] f32
+    leaf_parent: jnp.ndarray     # [L] i32 node idx
+    leaf_is_left: jnp.ndarray    # [L] bool
+    tree: BuiltTree
+
+
+def _row_go_left(data: DeviceData, best: SplitResult, row_leaf, rows_feature,
+                 rows_bin):
+    """Per-row left/right decision for the leaf's chosen split."""
+    l = row_leaf
+    f = rows_feature                                     # [n] split feature per row
+    b = rows_bin                                         # [n] bin at that feature
+    mt = data.missing_types[f]
+    is_missing = (((mt == MISSING_NAN) & (b == data.nan_bins[f]))
+                  | ((mt == MISSING_ZERO) & (b == data.default_bins[f])))
+    thr = best.threshold[l]
+    num_left = jnp.where(is_missing, best.default_left[l], b <= thr)
+    cat_left = best.cat_mask[l, jnp.minimum(b, best.cat_mask.shape[-1] - 1)]
+    return jnp.where(best.is_categorical[l], cat_left, num_left)
+
+
+def build_tree(data: DeviceData,
+               grad: jnp.ndarray,
+               hess: jnp.ndarray,
+               params: GrowthParams,
+               bag_mask: Optional[jnp.ndarray] = None,
+               feature_mask: Optional[jnp.ndarray] = None,
+               hist_fn=build_histograms,
+               psum_fn=None) -> BuiltTree:
+    """Grow one tree.  Jittable; `psum_fn` lets distributed learners inject
+    a collective over local histograms (the reference's ReduceScatter seam,
+    `data_parallel_tree_learner.cpp:147-162`)."""
+    n, F = data.bins.shape
+    L = params.num_leaves
+    Lm = max(L - 1, 1)
+    B = data.max_bins
+
+    row_leaf = jnp.zeros(n, jnp.int32)
+    hist_leaf = (jnp.where(bag_mask, 0, -1).astype(jnp.int32)
+                 if bag_mask is not None else jnp.zeros(n, jnp.int32))
+
+    tree = BuiltTree(
+        feature=jnp.zeros(Lm, jnp.int32),
+        threshold_bin=jnp.zeros(Lm, jnp.int32),
+        default_left=jnp.zeros(Lm, bool),
+        is_categorical=jnp.zeros(Lm, bool),
+        cat_mask=jnp.zeros((Lm, B), bool),
+        left_child=jnp.full(Lm, -1, jnp.int32),
+        right_child=jnp.full(Lm, -1, jnp.int32),
+        gain=jnp.zeros(Lm, jnp.float32),
+        internal_value=jnp.zeros(Lm, jnp.float32),
+        internal_count=jnp.zeros(Lm, jnp.int32),
+        leaf_value=jnp.zeros(L, jnp.float32),
+        leaf_count=jnp.zeros(L, jnp.int32),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+        num_leaves=jnp.asarray(1, jnp.int32),
+        row_leaf=row_leaf,
+    )
+
+    # root statistics (in-bag)
+    bag = (hist_leaf == 0)
+    sum_g = jnp.sum(jnp.where(bag, grad, 0.0))
+    sum_h = jnp.sum(jnp.where(bag, hess, 0.0))
+    cnt = jnp.sum(bag.astype(jnp.float32))
+    if psum_fn is not None:
+        sum_g, sum_h, cnt = psum_fn((sum_g, sum_h, cnt))
+
+    from ..ops.split import leaf_output as _leaf_out
+    root_out = _leaf_out(sum_g, sum_h, params.split.lambda_l1,
+                         params.split.lambda_l2)
+
+    state = _WaveState(
+        row_leaf=row_leaf, hist_leaf=hist_leaf,
+        nl=jnp.asarray(1, jnp.int32), done=jnp.asarray(False),
+        leaf_sum_grad=jnp.zeros(L).at[0].set(sum_g),
+        leaf_sum_hess=jnp.zeros(L).at[0].set(sum_h),
+        leaf_count=jnp.zeros(L).at[0].set(cnt),
+        leaf_depth=jnp.zeros(L, jnp.int32),
+        leaf_value=jnp.zeros(L, jnp.float32).at[0].set(root_out),
+        leaf_parent=jnp.full(L, -1, jnp.int32),
+        leaf_is_left=jnp.zeros(L, bool),
+        tree=tree,
+    )
+
+    wave = params.wave_size if params.wave_size > 0 else L
+
+    def cond(s: _WaveState):
+        return (~s.done) & (s.nl < L)
+
+    def body(s: _WaveState) -> _WaveState:
+        hist_flat = hist_fn(data.bins, grad, hess, s.hist_leaf,
+                            data.bin_offsets, L, data.total_bins)
+        if psum_fn is not None:
+            hist_flat = psum_fn(hist_flat)
+        grid = pad_to_feature_grid(hist_flat, data.bin_offsets,
+                                   data.num_bins, B)
+        best = find_best_splits(grid, s.leaf_sum_grad, s.leaf_sum_hess,
+                                s.leaf_count, data.num_bins,
+                                data.missing_types, data.default_bins,
+                                data.is_categorical, params.split,
+                                feature_mask,
+                                any_categorical=data.has_categorical)
+        lid = jnp.arange(L)
+        gain = jnp.where(lid < s.nl, best.gain, NEG_INF)
+        if params.max_depth > 0:
+            gain = jnp.where(s.leaf_depth >= params.max_depth, NEG_INF, gain)
+        can = gain > 0.0
+
+        order = jnp.argsort(-gain)                      # leaves by gain desc
+        rank = jnp.argsort(order)                       # rank[l]
+        budget = L - s.nl
+        k = jnp.minimum(jnp.minimum(jnp.sum(can), budget), wave)
+        sel = can & (rank < k)
+
+        new_id = jnp.where(sel, s.nl + rank, L)         # L => drop scatter
+        node_idx = jnp.where(sel, s.nl - 1 + rank, Lm)  # Lm => drop scatter
+
+        # --- record tree nodes (scatter at node_idx; drop where unselected)
+        t = s.tree
+        dl = jnp.where(best.is_categorical, False, best.default_left)
+        t = t._replace(
+            feature=t.feature.at[node_idx].set(best.feature, mode="drop"),
+            threshold_bin=t.threshold_bin.at[node_idx].set(best.threshold,
+                                                           mode="drop"),
+            default_left=t.default_left.at[node_idx].set(dl, mode="drop"),
+            is_categorical=t.is_categorical.at[node_idx].set(
+                best.is_categorical, mode="drop"),
+            cat_mask=t.cat_mask.at[node_idx].set(best.cat_mask, mode="drop"),
+            gain=t.gain.at[node_idx].set(best.gain, mode="drop"),
+            internal_value=t.internal_value.at[node_idx].set(
+                s.leaf_value, mode="drop"),
+            internal_count=t.internal_count.at[node_idx].set(
+                s.leaf_count.astype(jnp.int32), mode="drop"),
+            left_child=t.left_child.at[node_idx].set(~lid, mode="drop"),
+            right_child=t.right_child.at[node_idx].set(
+                ~new_id, mode="drop"),
+        )
+        # fix the parent's child pointer: leaf l was ~l, becomes node_idx
+        parent = jnp.where(sel, s.leaf_parent, -1)
+        fix_left = jnp.where(sel & s.leaf_is_left & (parent >= 0),
+                             parent, Lm)
+        fix_right = jnp.where(sel & ~s.leaf_is_left & (parent >= 0),
+                              parent, Lm)
+        t = t._replace(
+            left_child=t.left_child.at[fix_left].set(node_idx, mode="drop"),
+            right_child=t.right_child.at[fix_right].set(node_idx, mode="drop"),
+        )
+
+        # --- update leaf state: left child keeps id l, right child -> new_id
+        depth1 = s.leaf_depth + 1
+        lsg = jnp.where(sel, best.left_sum_grad, s.leaf_sum_grad)
+        lsh = jnp.where(sel, best.left_sum_hess, s.leaf_sum_hess)
+        lc = jnp.where(sel, best.left_count, s.leaf_count)
+        lv = jnp.where(sel, best.left_output, s.leaf_value)
+        ld = jnp.where(sel, depth1, s.leaf_depth)
+        lp = jnp.where(sel, node_idx, s.leaf_parent)
+        lil = jnp.where(sel, True, s.leaf_is_left)
+
+        lsg = lsg.at[new_id].set(best.right_sum_grad, mode="drop")
+        lsh = lsh.at[new_id].set(best.right_sum_hess, mode="drop")
+        lc = lc.at[new_id].set(best.right_count, mode="drop")
+        lv = lv.at[new_id].set(best.right_output, mode="drop")
+        ld = ld.at[new_id].set(depth1, mode="drop")
+        lp = lp.at[new_id].set(node_idx, mode="drop")
+        lil = lil.at[new_id].set(False, mode="drop")
+
+        # --- route rows ------------------------------------------------
+        def route(leaf_vec):
+            safe = jnp.maximum(leaf_vec, 0)
+            f = best.feature[safe]
+            b = jnp.take_along_axis(
+                data.bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+            go_left = _row_go_left(data, best, safe, f, b)
+            moved = sel[safe] & ~go_left & (leaf_vec >= 0)
+            return jnp.where(moved, new_id[safe], leaf_vec)
+
+        row_leaf2 = route(s.row_leaf)
+        hist_leaf2 = route(s.hist_leaf)
+
+        nl2 = s.nl + k
+        return _WaveState(
+            row_leaf=row_leaf2, hist_leaf=hist_leaf2, nl=nl2,
+            done=(k == 0),
+            leaf_sum_grad=lsg, leaf_sum_hess=lsh, leaf_count=lc,
+            leaf_depth=ld, leaf_value=lv, leaf_parent=lp, leaf_is_left=lil,
+            tree=t)
+
+    final = jax.lax.while_loop(cond, body, state)
+    return final.tree._replace(
+        leaf_value=final.leaf_value,
+        leaf_count=final.leaf_count.astype(jnp.int32),
+        leaf_depth=final.leaf_depth,
+        num_leaves=final.nl,
+        row_leaf=final.row_leaf,
+    )
+
+
+def predict_built_tree(tree: BuiltTree, data: DeviceData,
+                       bins: jnp.ndarray) -> jnp.ndarray:
+    """Leaf value per row of `bins` for a just-built tree (validation score
+    update path; train rows use ``tree.row_leaf`` directly)."""
+    n = bins.shape[0]
+    node = jnp.where(tree.num_leaves > 1, 0, ~0) * jnp.ones(n, jnp.int32)
+
+    def body(_, node):
+        is_leaf = node < 0
+        nidx = jnp.maximum(node, 0)
+        f = tree.feature[nidx]
+        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        mt = data.missing_types[f]
+        is_missing = (((mt == MISSING_NAN) & (b == data.nan_bins[f]))
+                      | ((mt == MISSING_ZERO) & (b == data.default_bins[f])))
+        num_left = jnp.where(is_missing, tree.default_left[nidx],
+                             b <= tree.threshold_bin[nidx])
+        cat_left = tree.cat_mask[nidx, jnp.minimum(b, tree.cat_mask.shape[-1] - 1)]
+        go_left = jnp.where(tree.is_categorical[nidx], cat_left, num_left)
+        nxt = jnp.where(go_left, tree.left_child[nidx], tree.right_child[nidx])
+        return jnp.where(is_leaf, node, nxt)
+
+    depth = tree.leaf_value.shape[0] - 1
+    node = jax.lax.fori_loop(0, depth, body, node)
+    leaf = jnp.where(node < 0, ~node, 0)
+    return tree.leaf_value[leaf]

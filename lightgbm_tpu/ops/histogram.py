@@ -1,0 +1,145 @@
+"""Gradient histogram construction — the hottest op in GBDT training.
+
+TPU-native redesign of the reference histogram machinery
+(`/root/reference/src/io/dataset.cpp:587-752` ``Dataset::ConstructHistograms``,
+`src/io/dense_bin.hpp` ``ConstructHistogram`` inner loops, and the OpenCL
+kernels `src/treelearner/ocl/histogram{16,64,256}.cl`):
+
+* The reference iterates feature groups with OpenMP, gathering ordered
+  gradients per leaf; the GPU path packs 4 features per workgroup and uses
+  local-memory atomic float adds.
+* Here there is ONE dense binned matrix ``[n, F]`` and one op that produces
+  histograms for ALL leaves at once, keyed by the current row→leaf
+  assignment: an XLA scatter-add over a flat ``(leaf, feature, bin)`` index
+  space.  No atomics are needed — XLA serializes duplicate indices in the
+  scatter, and on TPU the scatter lowers to an efficient sorted-segment
+  loop.  A Pallas one-hot-matmul kernel (``pallas_histogram.py``) can swap
+  in behind the same interface for the MXU fast path.
+
+Histogram cell layout matches ``HistogramBinEntry`` (`bin.h:27-55`):
+``(sum_grad, sum_hess, count)`` as a trailing axis of size 3, float32
+(the reference GPU path is also single-precision by default,
+`docs/GPU-Performance.rst:135-161`).
+
+The sibling-subtraction trick (`feature_histogram.hpp:64-70` ``Subtract``)
+is :func:`subtract_histogram`; the reference's ``FixHistogram``
+(`dataset.cpp:754-773`) reconstructs skipped default bins — unnecessary
+here because the dense scatter visits every row, but leaf-total
+consistency is still enforced in the split scan by using leaf sums from
+the partition, not the histogram.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+# Rows per scatter chunk: caps the [chunk, F, 3] update intermediate that
+# XLA may materialize when it cannot fuse the broadcast into the scatter.
+_DEFAULT_CHUNK = 1 << 18
+
+
+def _scatter_chunk(hist: jnp.ndarray, bins: jnp.ndarray, bin_offsets: jnp.ndarray,
+                   row_leaf: jnp.ndarray, vals: jnp.ndarray,
+                   total_bins: int) -> jnp.ndarray:
+    """Scatter-add one row-chunk into the flat [num_leaves*total_bins, 3] hist."""
+    # [chunk, F] global bin index within a leaf's histogram
+    idx = row_leaf[:, None] * total_bins + bin_offsets[None, :] + bins.astype(jnp.int32)
+    return hist.at[idx].add(vals[:, None, :], mode="drop")
+
+
+def build_histograms(bins: jnp.ndarray,
+                     grad: jnp.ndarray,
+                     hess: jnp.ndarray,
+                     row_leaf: jnp.ndarray,
+                     bin_offsets: jnp.ndarray,
+                     num_leaves: int,
+                     total_bins: int,
+                     chunk_rows: int = _DEFAULT_CHUNK) -> jnp.ndarray:
+    """Build per-leaf gradient histograms for every feature in one pass.
+
+    Args:
+      bins: ``[n, F]`` integer binned matrix (uint8/int32).
+      grad, hess: ``[n]`` float32 gradients / hessians.
+      row_leaf: ``[n]`` int32 leaf id per row; negative ids (e.g. bagged-out
+        rows) are dropped by the scatter.
+      bin_offsets: ``[F]`` int32 per-feature offset into the flat bin space
+        (``FeatureInfo.bin_offsets[:-1]``).
+      num_leaves: static leaf-slot count L.
+      total_bins: static sum of per-feature bin counts.
+
+    Returns:
+      ``[L, total_bins, 3]`` float32 histogram (sum_grad, sum_hess, count).
+    """
+    n = bins.shape[0]
+    vals = jnp.stack(
+        [grad, hess, jnp.ones_like(grad)], axis=-1).astype(jnp.float32)
+    # negative leaf ids -> out-of-range index -> dropped by scatter mode="drop"
+    safe_leaf = jnp.where(row_leaf < 0, num_leaves, row_leaf).astype(jnp.int32)
+    hist = jnp.zeros((num_leaves * total_bins, 3), dtype=jnp.float32)
+    bin_offsets = bin_offsets.astype(jnp.int32)
+
+    if n <= chunk_rows:
+        hist = _scatter_chunk(hist, bins, bin_offsets, safe_leaf, vals, total_bins)
+    else:
+        num_chunks = (n + chunk_rows - 1) // chunk_rows
+        pad = num_chunks * chunk_rows - n
+        if pad:
+            bins = jnp.pad(bins, ((0, pad), (0, 0)))
+            vals = jnp.pad(vals, ((0, pad), (0, 0)))
+            # padded rows get leaf id == num_leaves -> dropped
+            safe_leaf = jnp.pad(safe_leaf, (0, pad), constant_values=num_leaves)
+        bins_c = bins.reshape(num_chunks, chunk_rows, -1)
+        vals_c = vals.reshape(num_chunks, chunk_rows, 3)
+        leaf_c = safe_leaf.reshape(num_chunks, chunk_rows)
+
+        def body(h, xs):
+            b, v, l = xs
+            return _scatter_chunk(h, b, bin_offsets, l, v, total_bins), None
+
+        hist, _ = jax.lax.scan(body, hist, (bins_c, vals_c, leaf_c))
+    return hist.reshape(num_leaves, total_bins, 3)
+
+
+def build_histogram_single(bins: jnp.ndarray,
+                           grad: jnp.ndarray,
+                           hess: jnp.ndarray,
+                           row_mask: jnp.ndarray,
+                           bin_offsets: jnp.ndarray,
+                           total_bins: int,
+                           chunk_rows: int = _DEFAULT_CHUNK) -> jnp.ndarray:
+    """Histogram over one row subset (the "smaller leaf" in the reference's
+    smaller/larger strategy, `serial_tree_learner.cpp:358-372`).
+
+    Returns ``[total_bins, 3]``.
+    """
+    leaf = jnp.where(row_mask, 0, -1).astype(jnp.int32)
+    hist = build_histograms(bins, grad, hess, leaf, bin_offsets,
+                            num_leaves=1, total_bins=total_bins,
+                            chunk_rows=chunk_rows)
+    return hist[0]
+
+
+def subtract_histogram(parent: jnp.ndarray, child: jnp.ndarray) -> jnp.ndarray:
+    """Sibling histogram by subtraction (`feature_histogram.hpp:64-70`)."""
+    return parent - child
+
+
+def pad_to_feature_grid(hist_flat: jnp.ndarray, bin_offsets: jnp.ndarray,
+                        num_bins: jnp.ndarray, max_bins: int) -> jnp.ndarray:
+    """Reshape flat ``[..., total_bins, 3]`` histograms to a padded
+    ``[..., F, max_bins, 3]`` grid for the vectorized split scan.
+
+    Out-of-range (padding) bins read bin 0 of the feature but are masked in
+    the scan via ``num_bins``; to keep them harmless we instead clamp the
+    gather index to the feature's own range and zero the result.
+    """
+    F = bin_offsets.shape[0]
+    b = jnp.arange(max_bins)
+    # [F, max_bins] flat index, clamped inside each feature's span
+    idx = bin_offsets[:, None] + jnp.minimum(b[None, :], num_bins[:, None] - 1)
+    valid = b[None, :] < num_bins[:, None]
+    grid = hist_flat[..., idx, :]              # [..., F, max_bins, 3]
+    return grid * valid[..., None].astype(grid.dtype)
