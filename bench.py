@@ -1,0 +1,66 @@
+"""Benchmark: HIGGS-equivalent binary GBDT training throughput on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline (BASELINE.md): the reference trains HIGGS (10.5M rows x 28
+features, 500 iterations, num_leaves=255) in 238.505 s on a dual-Xeon
+28-core box -> 22.0M row-iterations/second.  We measure steady-state
+training throughput on a synthetic HIGGS-shaped dataset and report
+row-iterations/second; vs_baseline > 1 means faster than the reference
+CPU number.
+
+Size is env-tunable: BENCH_ROWS (default 1,000,000), BENCH_ITERS (20),
+BENCH_LEAVES (255), BENCH_BIN (63).
+"""
+import json
+import os
+import time
+
+import numpy as np
+
+REFERENCE_ROW_ITERS_PER_SEC = 10.5e6 * 500 / 238.505
+
+
+def main():
+    n = int(os.environ.get("BENCH_ROWS", 1_000_000))
+    iters = int(os.environ.get("BENCH_ITERS", 20))
+    leaves = int(os.environ.get("BENCH_LEAVES", 255))
+    max_bin = int(os.environ.get("BENCH_BIN", 63))
+    f = 28
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] - X[:, 2]
+         + rng.normal(scale=1.0, size=n) > 0).astype(np.float32)
+
+    import lightgbm_tpu as lgb
+    ds = lgb.Dataset(X, label=y, params={"max_bin": max_bin})
+    ds.construct()
+    del X
+
+    params = {"objective": "binary", "num_leaves": leaves,
+              "max_bin": max_bin, "learning_rate": 0.1,
+              "min_data_in_leaf": 20, "verbose": -1}
+
+    from lightgbm_tpu.basic import Booster
+    bst = Booster(params=params, train_set=ds)
+    # warmup (compile)
+    bst.update()
+    t0 = time.time()
+    for _ in range(iters):
+        bst.update()
+    wall = time.time() - t0
+
+    row_iters_per_sec = n * iters / wall
+    vs = row_iters_per_sec / REFERENCE_ROW_ITERS_PER_SEC
+    print(json.dumps({
+        "metric": "higgs_shape_train_row_iters_per_sec",
+        "value": round(row_iters_per_sec, 1),
+        "unit": "row_iters/s",
+        "vs_baseline": round(vs, 4),
+    }))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,33 @@
+"""LightGBM-TPU: a TPU-native gradient boosting framework.
+
+A ground-up JAX/XLA re-design with the capabilities of LightGBM
+(reference: /root/reference, LightGBM v2.1.0 fork): histogram-based GBDT
+with leaf-wise growth, DART/GOSS/RF boosting, 16 objectives, 21 metrics,
+categorical features, EFB, distributed data/feature/voting-parallel
+learners over jax.sharding meshes, and a scikit-learn compatible API.
+"""
+from .basic import Booster, Dataset
+from .callback import (EarlyStopException, early_stopping, print_evaluation,
+                       record_evaluation, reset_parameter)
+from .engine import cv, train
+
+__version__ = "0.1.0"
+
+__all__ = [
+    "Dataset", "Booster", "train", "cv",
+    "early_stopping", "print_evaluation", "record_evaluation",
+    "reset_parameter", "EarlyStopException",
+    "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
+    "plot_importance", "plot_metric", "plot_tree",
+]
+
+
+def __getattr__(name):
+    # lazy imports to avoid hard sklearn/matplotlib dependencies at import
+    if name in ("LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker"):
+        from . import sklearn as _sk
+        return getattr(_sk, name)
+    if name in ("plot_importance", "plot_metric", "plot_tree"):
+        from . import plotting as _pl
+        return getattr(_pl, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,2 @@
+from .gbdt import GBDT
+from .variants import DART, GOSS, RF, create_boosting

@@ -1,0 +1,228 @@
+"""DART, GOSS, and Random Forest boosting variants.
+
+TPU-native counterparts of the reference subclasses
+(`/root/reference/src/boosting/dart.hpp`, `goss.hpp`, `rf.hpp`; factory
+`boosting.cpp:30-63`).
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..learner.serial import build_tree
+from ..utils.log import log_info
+from .gbdt import GBDT
+
+
+class DART(GBDT):
+    """Dropout trees (reference dart.hpp:23-199).
+
+    Per iteration (exact reference flow, ``DroppingTrees``/``Normalize``):
+    a random subset of past *iterations* is dropped from the training
+    score; the new tree is trained with shrinkage ``lr/(1+k)`` (or
+    ``lr/(lr+k)`` in xgboost mode); afterwards each dropped tree is
+    rescaled to ``k/(k+1)`` (resp. ``k/(k+lr)``) of its old weight and the
+    train/valid scores are patched accordingly."""
+
+    boosting_name = "dart"
+
+    def __init__(self, config: Config, train_set, objective=None, fobj=None):
+        super().__init__(config, train_set, objective, fobj)
+        self._rng_drop = np.random.RandomState(config.drop_seed)
+        self._tree_weights: list = []   # per-iteration DART weight
+        self._sum_weight = 0.0
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        c = self.config
+        K = self.num_tree_per_iteration
+        lr = c.learning_rate
+        drop_iters = self._select_drop()
+        k = float(len(drop_iters))
+        # contribution of each dropped tree at its current scale
+        drop_preds = {}       # (iter, class) -> (train_pred, [valid_preds])
+        for di in drop_iters:
+            for cls in range(K):
+                t = self.models[di * K + cls]
+                tp = self._predict_host_tree_binned(t, self.device_data)
+                vps = [self._predict_host_tree_binned(t, vd)
+                       for vd in self._valid_device]
+                drop_preds[(di, cls)] = (tp, vps)
+                self.scores = self.scores.at[:, cls].add(-tp)
+        # new-tree shrinkage (dart.hpp:127-134)
+        if not c.xgboost_dart_mode:
+            self.shrinkage_rate = lr / (1.0 + k)
+        else:
+            self.shrinkage_rate = lr if k == 0 else lr / (lr + k)
+        finished = super().train_one_iter(grad, hess)
+        if finished:
+            return True
+        # Normalize (dart.hpp:146-186): dropped tree weight *= factor;
+        # train score had it fully removed -> add back factor * pred;
+        # valid score still holds it fully -> add (factor - 1) * pred.
+        factor = (k / (k + 1.0)) if not c.xgboost_dart_mode else (
+            k / (k + lr) if k > 0 else 1.0)
+        for di in drop_iters:
+            for cls in range(K):
+                t = self.models[di * K + cls]
+                t.shrinkage(factor)
+                tp, vps = drop_preds[(di, cls)]
+                self.scores = self.scores.at[:, cls].add(factor * tp)
+                for vi, vp in enumerate(vps):
+                    self._valid_scores[vi] = \
+                        self._valid_scores[vi].at[:, cls].add((factor - 1.0) * vp)
+            if not c.uniform_drop:
+                self._sum_weight -= self._tree_weights[di] * (
+                    1.0 / (k + 1.0) if not c.xgboost_dart_mode
+                    else 1.0 / (k + lr))
+                self._tree_weights[di] *= factor
+        if not c.uniform_drop:
+            self._tree_weights.append(self.shrinkage_rate)
+            self._sum_weight += self.shrinkage_rate
+        self._stacked_cache = None
+        return False
+
+    def _select_drop(self) -> np.ndarray:
+        """Reference DroppingTrees (dart.hpp:85-125): per-iteration Bernoulli
+        with rate drop_rate (weight-scaled unless uniform_drop)."""
+        c = self.config
+        iters = self.iter
+        if iters == 0 or self._rng_drop.rand() < c.skip_drop:
+            return np.zeros(0, np.int64)
+        out = []
+        if not c.uniform_drop and self._sum_weight > 0:
+            inv_avg = len(self._tree_weights) / self._sum_weight
+            rate = c.drop_rate
+            if c.max_drop > 0:
+                rate = min(rate, c.max_drop * inv_avg / self._sum_weight)
+            for i in range(iters):
+                if self._rng_drop.rand() < rate * self._tree_weights[i] * inv_avg:
+                    out.append(i)
+                    if c.max_drop > 0 and len(out) >= c.max_drop:
+                        break
+        else:
+            rate = c.drop_rate
+            if c.max_drop > 0:
+                rate = min(rate, c.max_drop / max(1.0, float(iters)))
+            for i in range(iters):
+                if self._rng_drop.rand() < rate:
+                    out.append(i)
+                    if c.max_drop > 0 and len(out) >= c.max_drop:
+                        break
+        return np.asarray(out, np.int64)
+
+
+class GOSS(GBDT):
+    """Gradient-based One-Side Sampling (reference goss.hpp:36-214): keep
+    the top `top_rate` rows by |grad·hess|, sample `other_rate` of the rest
+    and amplify their gradients by (1-a)/b."""
+
+    boosting_name = "goss"
+
+    def __init__(self, config: Config, train_set, objective=None, fobj=None):
+        super().__init__(config, train_set, objective, fobj)
+        self._rng_goss = np.random.RandomState(config.bagging_seed)
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        c = self.config
+        if grad is None or hess is None:
+            grad, hess = self._gradients()
+        n = self.num_data
+        a, b = c.top_rate, c.other_rate
+        top_k = max(1, int(n * a))
+        # importance = sum over classes of |g*h| (goss.hpp BaggingHelper)
+        imp = jnp.sum(jnp.abs(grad * hess), axis=1)
+        threshold = jnp.sort(imp)[-top_k]
+        is_top = imp >= threshold
+        rnd = jnp.asarray(self._rng_goss.rand(n))
+        is_other = (~is_top) & (rnd < b / max(1e-12, 1.0 - a))
+        multiplier = (1.0 - a) / max(b, 1e-12)
+        scale = jnp.where(is_other, multiplier, 1.0)[:, None]
+        bag = is_top | is_other
+        grad = grad * scale
+        hess = hess * scale
+        self._goss_bag = bag
+        return self._train_with_bag(grad, hess, bag)
+
+    def _train_with_bag(self, grad, hess, bag) -> bool:
+        finished = True
+        K = self.num_tree_per_iteration
+        for k in range(K):
+            fmask = self._feature_mask()
+            bt = self._build_tree(grad[:, k], hess[:, k], bag, fmask)
+            if int(bt.num_leaves) > 1:
+                finished = False
+            bt = self._renew_leaves(bt, k)
+            self._update_scores(bt, k)
+            host = self._to_host_tree(bt)
+            host.shrinkage(self.shrinkage_rate)
+            if len(self.models) < K and abs(self.init_score_value) > 1e-15:
+                host.add_bias(self.init_score_value)
+            self.models.append(host)
+        self.iter += 1
+        self._stacked_cache = None
+        return finished
+
+
+class RF(GBDT):
+    """Random forest mode (reference rf.hpp:15-207): mandatory bagging, no
+    shrinkage, gradients always computed from the 0-score baseline, outputs
+    averaged over trees."""
+
+    boosting_name = "rf"
+    average_output = True
+
+    def __init__(self, config: Config, train_set, objective=None, fobj=None):
+        super().__init__(config, train_set, objective, fobj)
+        self.shrinkage_rate = 1.0
+        # RF gradients are w.r.t. the constant init score only (rf.hpp:80+)
+        if train_set is not None:
+            K = self.num_tree_per_iteration
+            self._base_score = jnp.full((self.num_data, K),
+                                        self.init_score_value, jnp.float32)
+
+    def _gradients(self):
+        saved = self.scores
+        self.scores = self._base_score
+        try:
+            return super()._gradients()
+        finally:
+            self.scores = saved
+
+    def _update_scores(self, bt, k):
+        # accumulate raw sums; averaging happens at predict time
+        self.scores = self.scores.at[:, k].add(bt.leaf_value[bt.row_leaf])
+        from ..learner.serial import predict_built_tree
+        for i, vd in enumerate(self._valid_device):
+            pred = predict_built_tree(bt, vd, vd.bins)
+            self._valid_scores[i] = self._valid_scores[i].at[:, k].add(pred)
+
+    def eval_train(self):
+        return self._eval_avg(super().eval_train)
+
+    def eval_valid(self):
+        return self._eval_avg(super().eval_valid)
+
+    def _eval_avg(self, fn):
+        # temporarily average scores for metric evaluation
+        T = max(1, len(self.models) // max(1, self.num_tree_per_iteration))
+        ss, vs = self.scores, list(self._valid_scores)
+        self.scores = self.scores / T
+        self._valid_scores = [v / T for v in self._valid_scores]
+        try:
+            return fn()
+        finally:
+            self.scores, self._valid_scores = ss, vs
+
+
+def create_boosting(config: Config, train_set=None, objective=None, fobj=None):
+    """Factory (reference Boosting::CreateBoosting, boosting.cpp:30-63)."""
+    cls = {"gbdt": GBDT, "dart": DART, "goss": GOSS, "rf": RF}[
+        config.boosting_type]
+    booster = cls(config, train_set, objective, fobj)
+    if config.input_model:
+        with open(config.input_model) as f:
+            booster.load_model_from_string(f.read())
+    return booster

@@ -1,0 +1,180 @@
+"""Command-line interface.
+
+Counterpart of the reference CLI
+(`/root/reference/src/main.cpp:4-23` → ``Application``,
+`src/application/application.cpp:49-82` config parsing, `:239-342`
+InitTrain/Train/Predict): reads the same ``key=value`` config-file format
+(``train.conf``), supports ``task=train|predict|refit|convert_model``
+(`config.h:89-91`), data/valid files with ``.weight``/``.query`` side
+files, model save/load, and the fork's snapshot behavior.
+
+Usage:
+    python -m lightgbm_tpu config=train.conf [key=value ...]
+"""
+from __future__ import annotations
+
+import sys
+from typing import Dict, List
+
+import numpy as np
+
+from .config import Config, canonicalize_params
+from .utils.log import log_info, log_warning, set_verbosity
+
+
+def parse_cli_args(argv: List[str]) -> Dict[str, str]:
+    """argv ``key=value`` pairs + optional config file (application.cpp:49-82:
+    CLI args override config-file values)."""
+    kv: Dict[str, str] = {}
+    for arg in argv:
+        if "=" not in arg:
+            log_warning(f"unknown argument {arg!r} (expected key=value)")
+            continue
+        k, v = arg.split("=", 1)
+        kv[k.strip()] = v.strip()
+    file_kv: Dict[str, str] = {}
+    cfg_path = kv.get("config", kv.get("config_file"))
+    if cfg_path:
+        file_kv = parse_config_file(cfg_path)
+    file_kv.update(kv)      # CLI wins
+    file_kv.pop("config", None)
+    file_kv.pop("config_file", None)
+    return file_kv
+
+
+def parse_config_file(path: str) -> Dict[str, str]:
+    """key=value lines, '#' comments (application.cpp:60-77)."""
+    out: Dict[str, str] = {}
+    with open(path) as f:
+        for line in f:
+            line = line.split("#", 1)[0].strip()
+            if not line or "=" not in line:
+                continue
+            k, v = line.split("=", 1)
+            out[k.strip()] = v.strip()
+    return out
+
+
+def run(argv: List[str]) -> int:
+    params = parse_cli_args(argv)
+    cfg = Config.from_params(params)
+    set_verbosity(cfg.verbose)
+    task = cfg.task
+    if task == "train":
+        _run_train(cfg, params)
+    elif task in ("predict", "prediction", "test"):
+        _run_predict(cfg, params)
+    elif task == "refit":
+        _run_refit(cfg, params)
+    elif task == "convert_model":
+        _run_convert(cfg, params)
+    else:
+        raise ValueError(f"unknown task {task!r}")
+    return 0
+
+
+def _run_train(cfg: Config, params) -> None:
+    from .basic import Booster, Dataset
+    from .engine import train
+
+    if not cfg.data:
+        raise ValueError("task=train requires data=<file>")
+    train_set = Dataset(cfg.data, params=params)
+    valid_sets = [Dataset(v, params=params, reference=train_set)
+                  for v in cfg.valid_data]
+    valid_names = [f"valid_{i}" for i in range(len(valid_sets))]
+    booster = train(params, train_set, num_boost_round=cfg.num_iterations,
+                    valid_sets=valid_sets, valid_names=valid_names,
+                    init_model=cfg.input_model or None,
+                    early_stopping_rounds=cfg.early_stopping_round or None,
+                    verbose_eval=cfg.output_freq)
+    booster.save_model(cfg.output_model)
+    log_info(f"finished training; model saved to {cfg.output_model}")
+
+
+def _load_predict_input(cfg: Config):
+    from .io.loader import parse_file
+    X, label, _w, _q, _names, _cat = parse_file(cfg.data, cfg)
+    return X, label
+
+
+def _run_predict(cfg: Config, params) -> None:
+    from .basic import Booster
+    if not cfg.input_model:
+        raise ValueError("task=predict requires input_model=<file>")
+    booster = Booster(params=dict(params), model_file=cfg.input_model)
+    X, _ = _load_predict_input(cfg)
+    if cfg.is_predict_leaf_index:
+        out = booster.predict(X, pred_leaf=True,
+                              num_iteration=cfg.num_iteration_predict)
+    elif cfg.is_predict_contrib:
+        out = booster.predict(X, pred_contrib=True,
+                              num_iteration=cfg.num_iteration_predict)
+    else:
+        out = booster.predict(X, raw_score=cfg.is_predict_raw_score,
+                              num_iteration=cfg.num_iteration_predict)
+    out = np.asarray(out)
+    if out.ndim == 1:
+        out = out[:, None]
+    np.savetxt(cfg.output_result, out, delimiter="\t", fmt="%.9g")
+    log_info(f"finished prediction; results saved to {cfg.output_result}")
+
+
+def _run_refit(cfg: Config, params) -> None:
+    """task=refit (application.cpp:293-318 KRefitTree): re-estimate leaf
+    outputs of an existing model on new data."""
+    from .basic import Booster, Dataset
+    if not cfg.input_model:
+        raise ValueError("task=refit requires input_model=<file>")
+    booster = Booster(params=dict(params), model_file=cfg.input_model)
+    data = Dataset(cfg.data, params=dict(params))
+    data.construct()
+    ds = data._constructed
+    g = booster._gbdt
+    g.train_set = ds
+    for t in g.models:
+        t.align_with_mappers(ds.mappers,
+                             {f: i for i, f in enumerate(ds.used_features)})
+    from .io.device import to_device
+    g.device_data = to_device(ds)
+    g.num_data = ds.num_data
+    from .objective.objectives import create_objective
+    g.objective = create_objective(cfg)
+    g.objective.init(ds.metadata, ds.num_data)
+    K = g.num_tree_per_iteration
+    import jax.numpy as jnp
+    g.scores = jnp.zeros((ds.num_data, K), jnp.float32)
+    # leaf indices of each row under each tree
+    from .models.tree import stack_trees, predict_leaf_binned
+    dd = g.device_data
+    st = stack_trees(g.models, max_bins=dd.max_bins)
+    pred_leaf = np.asarray(predict_leaf_binned(
+        st, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types))
+    g.refit(pred_leaf)
+    booster.save_model(cfg.output_model)
+    log_info(f"finished refit; model saved to {cfg.output_model}")
+
+
+def _run_convert(cfg: Config, params) -> None:
+    """task=convert_model: if-else code generation
+    (gbdt_model_text.cpp:51-233 ModelToIfElse).  Emits C++."""
+    from .basic import Booster
+    from .models.codegen import model_to_ifelse
+    booster = Booster(params=dict(params), model_file=cfg.input_model)
+    code = model_to_ifelse(booster._gbdt)
+    out = cfg.convert_model
+    with open(out, "w") as f:
+        f.write(code)
+    log_info(f"model converted to if-else code at {out}")
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if not argv:
+        print(__doc__)
+        return 1
+    return run(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

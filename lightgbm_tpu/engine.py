@@ -1,0 +1,244 @@
+"""Training engine: ``train()`` and ``cv()``.
+
+Signature parity with the reference
+(`/root/reference/python-package/lightgbm/engine.py:18` ``train``,
+`:312` ``cv``): same argument names and callback protocol, driving the
+TPU booster instead of the C API.
+"""
+from __future__ import annotations
+
+import collections
+import copy
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+from . import callback as callback_mod
+from .basic import Booster, Dataset
+from .config import canonicalize_params
+from .utils.log import log_info, log_warning
+
+
+def train(params: Dict[str, Any], train_set: Dataset,
+          num_boost_round: int = 100,
+          valid_sets: Optional[Sequence[Dataset]] = None,
+          valid_names: Optional[Sequence[str]] = None,
+          fobj=None, feval=None, init_model=None,
+          feature_name="auto", categorical_feature="auto",
+          early_stopping_rounds: Optional[int] = None,
+          evals_result: Optional[Dict] = None,
+          verbose_eval=True, learning_rates=None,
+          keep_training_booster: bool = False,
+          callbacks: Optional[Sequence] = None) -> Booster:
+    """Train one model (reference engine.py:18-310)."""
+    params = canonicalize_params(dict(params or {}))
+    if "num_iterations" in params:
+        num_boost_round = int(params["num_iterations"])
+    params["num_iterations"] = num_boost_round
+    if fobj is not None:
+        params["objective"] = "none"
+        params["fobj"] = fobj
+    if early_stopping_rounds is None and params.get("early_stopping_round"):
+        early_stopping_rounds = int(params["early_stopping_round"])
+    params.pop("early_stopping_round", None)
+
+    train_set.feature_name = feature_name if feature_name != "auto" \
+        else train_set.feature_name
+    if categorical_feature != "auto":
+        train_set.categorical_feature = categorical_feature
+    train_set.params = {**params, **train_set.params}
+
+    booster = Booster(params=params, train_set=train_set)
+    if init_model is not None:
+        if isinstance(init_model, str):
+            with open(init_model) as f:
+                init_str = f.read()
+        elif isinstance(init_model, Booster):
+            init_str = init_model.model_to_string()
+        else:
+            init_str = init_model
+        _continue_training(booster, init_str)
+
+    valid_sets = list(valid_sets or [])
+    valid_names = list(valid_names or [])
+    for i, vs in enumerate(valid_sets):
+        name = valid_names[i] if i < len(valid_names) else f"valid_{i}"
+        if vs is train_set:
+            name = valid_names[i] if i < len(valid_names) else "training"
+            continue
+        booster.add_valid(vs, name)
+
+    cbs = list(callbacks or [])
+    if verbose_eval is True:
+        cbs.append(callback_mod.print_evaluation())
+    elif isinstance(verbose_eval, int) and verbose_eval > 1:
+        cbs.append(callback_mod.print_evaluation(verbose_eval))
+    if early_stopping_rounds is not None and early_stopping_rounds > 0:
+        cbs.append(callback_mod.early_stopping(
+            early_stopping_rounds, verbose=bool(verbose_eval)))
+    if evals_result is not None:
+        cbs.append(callback_mod.record_evaluation(evals_result))
+    if learning_rates is not None:
+        cbs.append(callback_mod.reset_parameter(learning_rate=learning_rates))
+    cbs_before = [cb for cb in cbs if getattr(cb, "before_iteration", False)]
+    cbs_after = [cb for cb in cbs if not getattr(cb, "before_iteration", False)]
+    cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
+    cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
+
+    for it in range(num_boost_round):
+        env = callback_mod.CallbackEnv(
+            model=booster, params=params, iteration=it,
+            begin_iteration=0, end_iteration=num_boost_round,
+            evaluation_result_list=None)
+        for cb in cbs_before:
+            cb(env)
+        finished = booster.update(fobj=fobj)
+        if finished:
+            log_info(f"training stopped at iteration {it + 1}: no further "
+                     f"splits possible")
+            break
+        evaluation_result_list = []
+        if valid_sets or params.get("is_training_metric"):
+            if params.get("is_training_metric"):
+                evaluation_result_list.extend(booster.eval_train(feval))
+            evaluation_result_list.extend(booster.eval_valid(feval))
+        env = env._replace(evaluation_result_list=evaluation_result_list)
+        try:
+            for cb in cbs_after:
+                cb(env)
+        except callback_mod.EarlyStopException as e:
+            booster.best_iteration = e.best_iteration + 1
+            for name, metric, val, _ in (e.best_score or []):
+                booster.best_score.setdefault(name, {})[metric] = val
+            break
+    if booster.best_iteration <= 0:
+        booster.best_iteration = booster.current_iteration
+    if not keep_training_booster:
+        booster.free_dataset()
+    return booster
+
+
+def _continue_training(booster: Booster, init_model_str: str) -> None:
+    """Merge a loaded model's trees, continuing iteration numbering
+    (reference boosting.cpp:44-62 MergeFrom + init-score replay)."""
+    from .boosting.gbdt import GBDT
+    from .config import Config
+    loaded = GBDT(Config.from_params({}), None)
+    loaded.load_model_from_string(init_model_str)
+    g = booster._gbdt
+    if loaded.num_tree_per_iteration != g.num_tree_per_iteration:
+        raise ValueError("cannot continue training: num_tree_per_iteration "
+                         "differs between init_model and params")
+    for t in loaded.models:
+        t.align_with_mappers(
+            g.train_set.mappers,
+            {f: i for i, f in enumerate(g.train_set.used_features)})
+    g.models = loaded.models + g.models
+    g.iter += loaded.iter
+    # replay loaded trees into the training scores
+    import jax.numpy as jnp
+    K = g.num_tree_per_iteration
+    for i, t in enumerate(loaded.models):
+        k = i % K
+        pred = g._predict_host_tree_binned(t, g.device_data)
+        g.scores = g.scores.at[:, k].add(pred)
+
+
+def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
+       folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
+       metrics=None, fobj=None, feval=None, init_model=None,
+       feature_name="auto", categorical_feature="auto",
+       early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
+       show_stdv: bool = True, seed: int = 0, callbacks=None) -> Dict:
+    """K-fold cross-validation (reference engine.py:312-448)."""
+    params = canonicalize_params(dict(params or {}))
+    if metrics:
+        params["metric"] = metrics
+    train_set.construct()
+    n = train_set.num_data()
+    label = np.asarray(train_set.get_label())
+    rng = np.random.RandomState(seed)
+
+    if folds is not None:
+        fold_list = list(folds.split(np.zeros(n), label)
+                         if hasattr(folds, "split") else folds)
+    else:
+        group = train_set.get_group()
+        if group is not None:
+            # group-aware folds: assign whole queries to folds
+            qb = np.asarray(train_set.get_field("group"))
+            nq = len(qb) - 1
+            order = rng.permutation(nq) if shuffle else np.arange(nq)
+            fold_of_q = np.empty(nq, int)
+            for i, q in enumerate(order):
+                fold_of_q[q] = i % nfold
+            row_fold = np.repeat(fold_of_q, np.diff(qb))
+            fold_list = [(np.nonzero(row_fold != f)[0],
+                          np.nonzero(row_fold == f)[0]) for f in range(nfold)]
+        elif stratified and params.get("objective") in ("binary", "multiclass",
+                                                        "multiclassova"):
+            fold_list = _stratified_folds(label, nfold, rng, shuffle)
+        else:
+            idx = rng.permutation(n) if shuffle else np.arange(n)
+            fold_list = [(np.sort(np.concatenate(
+                [idx[j::nfold] for j in range(nfold) if j != f])),
+                np.sort(idx[f::nfold])) for f in range(nfold)]
+
+    results = collections.defaultdict(list)
+    boosters = []
+    for f, (tr_idx, va_idx) in enumerate(fold_list):
+        tr = train_set.subset(np.sort(tr_idx))
+        va = train_set.subset(np.sort(va_idx))
+        if fpreproc is not None:
+            tr, va, params = fpreproc(tr, va, dict(params))
+        bst = Booster(params=params, train_set=tr)
+        bst.add_valid(va, "valid")
+        boosters.append(bst)
+
+    best_iter = num_boost_round
+    es_counter = 0
+    best_mean = None
+    for it in range(num_boost_round):
+        iter_results = collections.defaultdict(list)
+        for bst in boosters:
+            bst.update(fobj=fobj)
+            for name, metric, val, hib in bst.eval_valid(feval):
+                iter_results[(metric, hib)].append(val)
+        for (metric, hib), vals in iter_results.items():
+            results[f"{metric}-mean"].append(float(np.mean(vals)))
+            results[f"{metric}-stdv"].append(float(np.std(vals)))
+        if verbose_eval:
+            msg = "\t".join(
+                f"cv_agg {m}: {results[f'{m}-mean'][-1]:g} + "
+                f"{results[f'{m}-stdv'][-1]:g}"
+                for (m, _h) in iter_results.keys())
+            log_info(f"[{it + 1}]\t{msg}")
+        if early_stopping_rounds:
+            (metric0, hib0) = next(iter(iter_results.keys()))
+            cur = results[f"{metric0}-mean"][-1]
+            better = (best_mean is None or
+                      (cur > best_mean if hib0 else cur < best_mean))
+            if better:
+                best_mean = cur
+                best_iter = it + 1
+                es_counter = 0
+            else:
+                es_counter += 1
+                if es_counter >= early_stopping_rounds:
+                    for key in list(results):
+                        results[key] = results[key][:best_iter]
+                    break
+    return dict(results)
+
+
+def _stratified_folds(label, nfold, rng, shuffle):
+    classes = np.unique(label)
+    test_folds = np.empty(len(label), int)
+    for cls in classes:
+        idx = np.nonzero(label == cls)[0]
+        if shuffle:
+            idx = rng.permutation(idx)
+        for f in range(nfold):
+            test_folds[idx[f::nfold]] = f
+    return [(np.nonzero(test_folds != f)[0], np.nonzero(test_folds == f)[0])
+            for f in range(nfold)]

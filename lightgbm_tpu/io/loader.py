@@ -1,0 +1,222 @@
+"""Text dataset loading: CSV / TSV / LibSVM with side files.
+
+Counterpart of the reference ``DatasetLoader`` + ``Parser``
+(`/root/reference/src/io/dataset_loader.cpp:159-219`, `src/io/parser.cpp`):
+format auto-detection, ``label_column``/``ignore_column``/
+``categorical_column`` handling (index ``N`` or ``name:xx`` syntax,
+`config.h` IOConfig docs), side files ``.weight``/``.query``/``.init``
+(`src/io/metadata.cpp` load paths), and distributed row sharding
+(pre-partition or ``i % num_machines``, `dataset_loader.cpp:639-742`).
+
+The inner parse runs through numpy (a C++ fast parser is the planned
+native replacement; the format contract lives here).
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.log import log_info, log_warning
+from .dataset import BinnedDataset, Metadata
+
+
+def detect_format(path: str, has_header: bool) -> str:
+    """CSV vs TSV vs LibSVM auto-detection (reference Parser::CreateParser,
+    src/io/parser.cpp format sniffing)."""
+    with open(path) as f:
+        lines = []
+        for _ in range(32):
+            ln = f.readline()
+            if not ln:
+                break
+            lines.append(ln.rstrip("\n"))
+    if has_header and lines:
+        lines = lines[1:]
+    if not lines:
+        return "csv"
+    sample = lines[0]
+    if ":" in sample.split(",")[0].split("\t")[0].split(" ")[-1] \
+            and any(":" in tok for tok in sample.split()[1:2]):
+        return "libsvm"
+    n_tab = sample.count("\t")
+    n_comma = sample.count(",")
+    if any(":" in tok for tok in sample.split()[1:]):
+        return "libsvm"
+    if n_tab >= n_comma and n_tab > 0:
+        return "tsv"
+    if n_comma > 0:
+        return "csv"
+    if " " in sample:
+        return "libsvm" if ":" in sample else "tsv"
+    return "csv"
+
+
+def _parse_column_spec(spec: str, header_names: Optional[List[str]]) -> int:
+    """Column spec: integer index or ``name:colname``."""
+    if spec.startswith("name:"):
+        name = spec[5:]
+        if not header_names:
+            raise ValueError(f"column {spec!r} needs a header")
+        return header_names.index(name)
+    return int(spec)
+
+
+def _parse_multi_spec(spec: str, header_names) -> List[int]:
+    if not spec:
+        return []
+    if spec.startswith("name:"):
+        names = spec[5:].split(",")
+        return [header_names.index(n) for n in names]
+    return [int(s) for s in spec.replace(";", ",").split(",") if s != ""]
+
+
+def parse_file(path: str, config: Config
+               ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
+                          Optional[np.ndarray], List[str], List[int]]:
+    """-> (X, label, weight, query, feature_names, categorical_cols)."""
+    fmt = detect_format(path, config.has_header)
+    header_names: Optional[List[str]] = None
+    skip = 0
+    if config.has_header:
+        with open(path) as f:
+            first = f.readline().rstrip("\n")
+        sep = {"csv": ",", "tsv": "\t", "libsvm": " "}[fmt]
+        header_names = first.split(sep)
+        skip = 1
+
+    weight_inline = None
+    query_inline = None
+    if fmt == "libsvm":
+        X, label = _parse_libsvm(path, skip)
+        feature_names = [f"Column_{i}" for i in range(X.shape[1])]
+        cat_cols: List[int] = []
+    else:
+        sep = "," if fmt == "csv" else "\t"
+        raw = np.genfromtxt(path, delimiter=sep, skip_header=skip,
+                            dtype=np.float64)
+        if raw.ndim == 1:
+            raw = raw.reshape(-1, 1)
+        ncol = raw.shape[1]
+        label_idx = (_parse_column_spec(config.label_column, header_names)
+                     if config.label_column else 0)
+        drop = {label_idx}
+        if config.weight_column:
+            wi = _parse_column_spec(config.weight_column, header_names)
+            weight_inline = raw[:, wi].astype(np.float32)
+            drop.add(wi)
+        if config.group_column:
+            qi = _parse_column_spec(config.group_column, header_names)
+            query_inline = raw[:, qi]
+            drop.add(qi)
+        for ig in _parse_multi_spec(config.ignore_column, header_names):
+            drop.add(ig)
+        keep = [i for i in range(ncol) if i not in drop]
+        label = raw[:, label_idx].astype(np.float32)
+        X = raw[:, keep]
+        if header_names:
+            feature_names = [header_names[i] for i in keep]
+        else:
+            feature_names = [f"Column_{i}" for i in range(len(keep))]
+        cat_spec = config.categorical_column
+        cat_cols = []
+        if cat_spec:
+            cat_orig = _parse_multi_spec(cat_spec, header_names)
+            remap = {orig: j for j, orig in enumerate(keep)}
+            cat_cols = [remap[c] for c in cat_orig if c in remap]
+    return X, label, weight_inline, query_inline, feature_names, cat_cols
+
+
+def _parse_libsvm(path: str, skip: int) -> Tuple[np.ndarray, np.ndarray]:
+    labels: List[float] = []
+    rows: List[List[Tuple[int, float]]] = []
+    max_idx = -1
+    with open(path) as f:
+        for i, line in enumerate(f):
+            if i < skip:
+                continue
+            line = line.strip()
+            if not line:
+                continue
+            toks = line.split()
+            labels.append(float(toks[0]))
+            feats = []
+            for tok in toks[1:]:
+                if ":" not in tok:
+                    continue
+                k, v = tok.split(":", 1)
+                idx = int(k)
+                feats.append((idx, float(v)))
+                max_idx = max(max_idx, idx)
+            rows.append(feats)
+    X = np.zeros((len(rows), max_idx + 1), np.float64)
+    for r, feats in enumerate(rows):
+        for idx, v in feats:
+            X[r, idx] = v
+    return X, np.asarray(labels, np.float32)
+
+
+def _load_side_file(path: str, dtype=np.float32) -> Optional[np.ndarray]:
+    if os.path.exists(path):
+        return np.loadtxt(path, dtype=dtype).reshape(-1)
+    return None
+
+
+def load_file(path: str, config: Config,
+              reference: Optional[BinnedDataset] = None,
+              rank: int = 0, num_machines: int = 1) -> BinnedDataset:
+    """Full file->BinnedDataset pipeline (reference
+    DatasetLoader::LoadFromFile, dataset_loader.cpp:159-219), incl. the
+    binary-cache fast path (SaveBinaryFile/CheckCanLoadFromBin)."""
+    bin_path = path + ".bin.npz"
+    if (config.enable_load_from_binary_file and reference is None
+            and os.path.exists(bin_path)
+            and os.path.getmtime(bin_path) >= os.path.getmtime(path)):
+        log_info(f"loading binary cache {bin_path}")
+        return BinnedDataset.load_binary(bin_path)
+
+    X, label, weight, query_inline, feature_names, cat_cols = \
+        parse_file(path, config)
+
+    # side files (reference metadata.cpp LoadWeights/LoadQueryBoundaries/
+    # LoadInitialScore)
+    w = _load_side_file(path + ".weight")
+    if w is not None:
+        weight = w
+    init_score = _load_side_file(path + ".init", np.float64)
+    q = _load_side_file(path + ".query", np.int64)
+
+    # distributed row sharding (dataset_loader.cpp:639-742): pre-partition
+    # means each rank already has its own file; otherwise mod-rank rows
+    if num_machines > 1 and not config.is_pre_partition:
+        sel = np.arange(rank, len(X), num_machines)
+        X, label = X[sel], label[sel]
+        if weight is not None:
+            weight = weight[sel]
+
+    md = Metadata()
+    md.set_field("label", label)
+    if weight is not None:
+        md.set_field("weight", weight)
+    if init_score is not None:
+        md.set_field("init_score", init_score)
+    if q is not None:
+        md.set_field("group", q.astype(np.int32))
+    elif query_inline is not None:
+        # group column: consecutive identical ids form queries
+        change = np.nonzero(np.diff(query_inline))[0] + 1
+        boundaries = np.concatenate([[0], change, [len(query_inline)]])
+        md.query_boundaries = boundaries.astype(np.int32)
+
+    if reference is not None:
+        ds = BinnedDataset.from_raw(X, config, reference=reference,
+                                    metadata=md)
+        return ds
+    ds = BinnedDataset.from_raw(X, config, categorical_features=cat_cols,
+                                feature_names=feature_names, metadata=md)
+    if config.is_save_binary_file:
+        ds.save_binary(bin_path[:-4])
+        log_info(f"saved binary cache {bin_path}")
+    return ds

@@ -105,6 +105,32 @@ def _row_go_left(data: DeviceData, best: SplitResult, row_leaf, rows_feature,
     return jnp.where(best.is_categorical[l], cat_left, num_left)
 
 
+def default_splitter(data: DeviceData, grad, hess, params: GrowthParams,
+                     feature_mask, psum_fn=None, hist_fn=build_histograms):
+    """The serial find-splits strategy: histograms for all leaves + one
+    vectorized scan.  Distributed learners swap this closure out (the
+    analog of the reference's learner-template matrix,
+    `tree_learner.cpp:9-33`); `psum_fn` injects the data-parallel
+    histogram collective (`data_parallel_tree_learner.cpp:147-162`)."""
+    L = params.num_leaves
+    B = data.max_bins
+
+    def splitter(hist_leaf, leaf_sum_grad, leaf_sum_hess, leaf_count):
+        hist_flat = hist_fn(data.bins, grad, hess, hist_leaf,
+                            data.bin_offsets, L, data.total_bins)
+        if psum_fn is not None:
+            hist_flat = psum_fn(hist_flat)
+        grid = pad_to_feature_grid(hist_flat, data.bin_offsets,
+                                   data.num_bins, B)
+        return find_best_splits(grid, leaf_sum_grad, leaf_sum_hess,
+                                leaf_count, data.num_bins,
+                                data.missing_types, data.default_bins,
+                                data.is_categorical, params.split,
+                                feature_mask,
+                                any_categorical=data.has_categorical)
+    return splitter
+
+
 def build_tree(data: DeviceData,
                grad: jnp.ndarray,
                hess: jnp.ndarray,
@@ -112,10 +138,12 @@ def build_tree(data: DeviceData,
                bag_mask: Optional[jnp.ndarray] = None,
                feature_mask: Optional[jnp.ndarray] = None,
                hist_fn=build_histograms,
-               psum_fn=None) -> BuiltTree:
+               psum_fn=None,
+               splitter=None) -> BuiltTree:
     """Grow one tree.  Jittable; `psum_fn` lets distributed learners inject
     a collective over local histograms (the reference's ReduceScatter seam,
-    `data_parallel_tree_learner.cpp:147-162`)."""
+    `data_parallel_tree_learner.cpp:147-162`); `splitter` replaces the whole
+    find-splits strategy (feature/voting-parallel)."""
     n, F = data.bins.shape
     L = params.num_leaves
     Lm = max(L - 1, 1)
@@ -169,23 +197,16 @@ def build_tree(data: DeviceData,
     )
 
     wave = params.wave_size if params.wave_size > 0 else L
+    if splitter is None:
+        splitter = default_splitter(data, grad, hess, params, feature_mask,
+                                    psum_fn=psum_fn, hist_fn=hist_fn)
 
     def cond(s: _WaveState):
         return (~s.done) & (s.nl < L)
 
     def body(s: _WaveState) -> _WaveState:
-        hist_flat = hist_fn(data.bins, grad, hess, s.hist_leaf,
-                            data.bin_offsets, L, data.total_bins)
-        if psum_fn is not None:
-            hist_flat = psum_fn(hist_flat)
-        grid = pad_to_feature_grid(hist_flat, data.bin_offsets,
-                                   data.num_bins, B)
-        best = find_best_splits(grid, s.leaf_sum_grad, s.leaf_sum_hess,
-                                s.leaf_count, data.num_bins,
-                                data.missing_types, data.default_bins,
-                                data.is_categorical, params.split,
-                                feature_mask,
-                                any_categorical=data.has_categorical)
+        best = splitter(s.hist_leaf, s.leaf_sum_grad, s.leaf_sum_hess,
+                        s.leaf_count)
         lid = jnp.arange(L)
         gain = jnp.where(lid < s.nl, best.gain, NEG_INF)
         if params.max_depth > 0:

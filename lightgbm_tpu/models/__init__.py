@@ -1,0 +1,1 @@
+from .tree import StackedTrees, Tree, predict_binned, stack_trees

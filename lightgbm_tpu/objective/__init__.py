@@ -1,0 +1,1 @@
+from .objectives import OBJECTIVES, ObjectiveFunction, create_objective

@@ -1,0 +1,278 @@
+"""End-to-end training tests — the counterpart of the reference's
+`tests/python_package_test/test_engine.py` (metric-threshold assertions per
+workload: binary/regression/multiclass/ranking, missing values,
+categoricals, early stopping, continued training, save/load/pickle, cv).
+"""
+import os
+import pickle
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+
+
+def _binary_data(n=1200, f=8, seed=7):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    logit = X[:, 0] * 2 + X[:, 1] - 0.5 * X[:, 2]
+    y = (logit + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def _auc(y, p):
+    order = np.argsort(p)
+    ranks = np.empty(len(y)); ranks[order] = np.arange(len(y))
+    pos = y > 0
+    n_pos, n_neg = pos.sum(), (~pos).sum()
+    return (ranks[pos].sum() - n_pos * (n_pos - 1) / 2) / (n_pos * n_neg)
+
+
+def test_binary():
+    X, y = _binary_data()
+    Xv, yv = _binary_data(seed=8)
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xv, label=yv)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "metric": ["auc", "binary_logloss"],
+                     "num_leaves": 15, "min_data_in_leaf": 10},
+                    train, num_boost_round=25, valid_sets=[valid],
+                    evals_result=evals, verbose_eval=False)
+    auc = evals["valid_0"]["auc"][-1]
+    assert auc > 0.93
+    p = bst.predict(Xv)
+    assert 0.0 <= p.min() and p.max() <= 1.0
+    # incremental f32 valid scores vs fresh prediction: tiny rank flips ok
+    assert abs(_auc(yv, p) - auc) < 1e-3
+
+
+def test_regression():
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(1500, 6)).astype(np.float32)
+    y = (X[:, 0] * 3 + X[:, 1] ** 2 + rng.normal(scale=0.3, size=1500)
+         ).astype(np.float32)
+    train = lgb.Dataset(X[:1000], label=y[:1000])
+    valid = train.create_valid(X[1000:], label=y[1000:])
+    evals = {}
+    lgb.train({"objective": "regression", "metric": "l2", "num_leaves": 31},
+              train, 30, valid_sets=[valid], evals_result=evals,
+              verbose_eval=False)
+    assert evals["valid_0"]["l2"][-1] < np.var(y[1000:]) * 0.35
+    # loss decreases
+    assert evals["valid_0"]["l2"][-1] < evals["valid_0"]["l2"][0]
+
+
+def test_missing_value_handling():
+    rng = np.random.RandomState(11)
+    X = rng.rand(800, 3).astype(np.float64)
+    y = (X[:, 0] > 0.5).astype(np.float32)
+    X[rng.rand(800) < 0.3, 0] = np.nan     # informative NaNs on feature 0
+    y[np.isnan(X[:, 0])] = 1.0
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary", "metric": "auc",
+                     "num_leaves": 7, "min_data_in_leaf": 5},
+                    train, 15, valid_sets=[train.create_valid(X, label=y)],
+                    verbose_eval=False)
+    p = bst.predict(X)
+    assert _auc(y, p) > 0.99
+
+
+def test_categorical_feature():
+    rng = np.random.RandomState(5)
+    n = 1000
+    cat = rng.randint(0, 8, n).astype(np.float64)
+    noise = rng.normal(size=n)
+    y = (np.isin(cat, [1, 3, 6]).astype(np.float64) * 2
+         + 0.1 * noise).astype(np.float32)
+    X = np.stack([cat, rng.normal(size=n)], 1)
+    train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    bst = lgb.train({"objective": "regression", "metric": "l2",
+                     "num_leaves": 7, "min_data_in_leaf": 5,
+                     "min_data_per_group": 1}, train, 10, verbose_eval=False)
+    p = bst.predict(X)
+    # categorical split should separate the two groups nearly perfectly
+    assert np.mean((p - y) ** 2) < 0.05
+
+
+def test_multiclass():
+    rng = np.random.RandomState(9)
+    n = 1500
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = np.argmax(X[:, :3] + 0.3 * rng.normal(size=(n, 3)), axis=1
+                  ).astype(np.float32)
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "multiclass", "num_class": 3,
+                     "metric": "multi_logloss", "num_leaves": 15},
+                    train, 15, verbose_eval=False)
+    p = bst.predict(X)
+    assert p.shape == (n, 3)
+    np.testing.assert_allclose(p.sum(axis=1), 1.0, atol=1e-5)
+    acc = np.mean(np.argmax(p, 1) == y)
+    assert acc > 0.85
+
+
+def test_lambdarank():
+    rng = np.random.RandomState(13)
+    n_q, per_q = 60, 20
+    n = n_q * per_q
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    rel = np.clip((X[:, 0] + 0.5 * rng.normal(size=n)) * 1.2 + 1.5,
+                  0, 4).astype(np.int32)
+    group = np.full(n_q, per_q)
+    train = lgb.Dataset(X, label=rel.astype(np.float32), group=group)
+    evals = {}
+    lgb.train({"objective": "lambdarank", "metric": "ndcg",
+               "ndcg_eval_at": [5], "num_leaves": 15, "min_data_in_leaf": 5},
+              train, 15,
+              valid_sets=[lgb.Dataset(X, label=rel.astype(np.float32),
+                                      group=group, reference=train)],
+              evals_result=evals, verbose_eval=False)
+    assert evals["valid_0"]["ndcg@5"][-1] > 0.75
+    assert evals["valid_0"]["ndcg@5"][-1] > evals["valid_0"]["ndcg@5"][0]
+
+
+def test_early_stopping():
+    X, y = _binary_data()
+    Xv, yv = _binary_data(seed=21)
+    train = lgb.Dataset(X, label=y)
+    valid = train.create_valid(Xv, label=yv)
+    bst = lgb.train({"objective": "binary", "metric": "binary_logloss",
+                     "num_leaves": 31, "learning_rate": 0.5},
+                    train, 200, valid_sets=[valid],
+                    early_stopping_rounds=5, verbose_eval=False)
+    assert bst.best_iteration < 200
+
+
+def test_continued_training():
+    X, y = _binary_data()
+    train = lgb.Dataset(X, label=y)
+    bst1 = lgb.train({"objective": "binary", "metric": "auc"}, train, 5,
+                     verbose_eval=False)
+    model_str = bst1.model_to_string()
+    train2 = lgb.Dataset(X, label=y)
+    bst2 = lgb.train({"objective": "binary", "metric": "auc"}, train2, 5,
+                     init_model=model_str, verbose_eval=False)
+    assert bst2.num_trees() == 10
+    p1 = bst1.predict(X[:50], raw_score=True)
+    p2 = bst2.predict(X[:50], raw_score=True, num_iteration=5)
+    np.testing.assert_allclose(p1, p2, atol=1e-5)
+
+
+def test_save_load_pickle(tmp_path):
+    X, y = _binary_data()
+    train = lgb.Dataset(X, label=y)
+    bst = lgb.train({"objective": "binary"}, train, 8, verbose_eval=False)
+    p = bst.predict(X[:100])
+    path = str(tmp_path / "model.txt")
+    bst.save_model(path)
+    loaded = lgb.Booster(model_file=path)
+    np.testing.assert_allclose(loaded.predict(X[:100]), p, atol=1e-6)
+    blob = pickle.dumps(bst)
+    unpickled = pickle.loads(blob)
+    np.testing.assert_allclose(unpickled.predict(X[:100]), p, atol=1e-6)
+
+
+def test_dump_model_json():
+    X, y = _binary_data(n=500)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7},
+                    lgb.Dataset(X, label=y), 3, verbose_eval=False)
+    d = bst.dump_model()
+    assert d["num_class"] == 1
+    assert len(d["tree_info"]) == 3
+    assert "tree_structure" in d["tree_info"][0]
+
+
+def test_cv():
+    X, y = _binary_data(n=600)
+    res = lgb.cv({"objective": "binary", "metric": "binary_logloss",
+                  "num_leaves": 7}, lgb.Dataset(X, label=y),
+                 num_boost_round=5, nfold=3, verbose_eval=False)
+    assert len(res["binary_logloss-mean"]) == 5
+    assert res["binary_logloss-mean"][-1] < res["binary_logloss-mean"][0]
+
+
+def test_dart():
+    X, y = _binary_data(n=800)
+    train = lgb.Dataset(X, label=y)
+    evals = {}
+    lgb.train({"objective": "binary", "boosting": "dart", "metric": "auc",
+               "drop_rate": 0.3, "num_leaves": 15},
+              train, 15, valid_sets=[train.create_valid(X, label=y)],
+              evals_result=evals, verbose_eval=False)
+    assert evals["valid_0"]["auc"][-1] > 0.9
+
+
+def test_goss():
+    X, y = _binary_data(n=2000)
+    train = lgb.Dataset(X, label=y)
+    evals = {}
+    lgb.train({"objective": "binary", "boosting": "goss", "metric": "auc",
+               "top_rate": 0.2, "other_rate": 0.1, "num_leaves": 15},
+              train, 15, valid_sets=[train.create_valid(X, label=y)],
+              evals_result=evals, verbose_eval=False)
+    assert evals["valid_0"]["auc"][-1] > 0.93
+
+
+def test_rf():
+    X, y = _binary_data(n=1500)
+    train = lgb.Dataset(X, label=y)
+    evals = {}
+    bst = lgb.train({"objective": "binary", "boosting": "rf", "metric": "auc",
+                     "bagging_freq": 1, "bagging_fraction": 0.7,
+                     "feature_fraction": 0.8, "num_leaves": 31},
+                    train, 10, valid_sets=[train.create_valid(X, label=y)],
+                    evals_result=evals, verbose_eval=False)
+    assert evals["valid_0"]["auc"][-1] > 0.9
+    p = bst.predict(X)
+    assert p.min() >= 0 and p.max() <= 1
+
+
+def test_custom_objective_fobj():
+    X, y = _binary_data(n=800)
+    train = lgb.Dataset(X, label=y)
+
+    def logloss_obj(score, dataset):
+        p = 1.0 / (1.0 + np.exp(-score))
+        return p - y, p * (1 - p)
+
+    bst = lgb.train({"metric": "auc", "num_leaves": 15}, train, 10,
+                    fobj=logloss_obj,
+                    valid_sets=[train.create_valid(X, label=y)],
+                    verbose_eval=False)
+    raw = bst.predict(X, raw_score=True)
+    assert _auc(y, raw) > 0.93
+
+
+def test_feature_importance():
+    X, y = _binary_data()
+    bst = lgb.train({"objective": "binary", "num_leaves": 15},
+                    lgb.Dataset(X, label=y), 10, verbose_eval=False)
+    imp = bst.feature_importance()
+    assert imp.shape == (X.shape[1],)
+    # features 0..2 are informative
+    assert imp[:3].sum() > imp[3:].sum()
+
+
+def test_pred_leaf_and_contrib():
+    X, y = _binary_data(n=400)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7},
+                    lgb.Dataset(X, label=y), 4, verbose_eval=False)
+    leaves = bst.predict(X[:30], pred_leaf=True)
+    assert leaves.shape == (30, 4)
+    assert leaves.max() < 7
+    contrib = bst.predict(X[:10], pred_contrib=True)
+    assert contrib.shape == (10, X.shape[1] + 1)
+    raw = bst.predict(X[:10], raw_score=True)
+    # SHAP sums to the raw prediction (reference test_engine.py:533-552)
+    np.testing.assert_allclose(contrib.sum(axis=1), raw, atol=1e-4)
+
+
+def test_weights_change_fit():
+    X, y = _binary_data(n=600)
+    w = np.where(y > 0, 10.0, 0.1).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7},
+                    lgb.Dataset(X, label=y, weight=w), 8, verbose_eval=False)
+    p_w = bst.predict(X).mean()
+    bst2 = lgb.train({"objective": "binary", "num_leaves": 7},
+                     lgb.Dataset(X, label=y), 8, verbose_eval=False)
+    assert p_w > bst2.predict(X).mean()     # positive-class upweighting
