@@ -70,7 +70,12 @@ class GBDT:
         self.train_set = train_set
         self.fobj = fobj or config.extra.get("fobj")
         self.objective = objective
-        self.models: List[Tree] = []
+        # host trees are materialized lazily: device BuiltTrees accumulate
+        # in _pending and convert in ONE batched device_get (each host
+        # round-trip through a remote-device tunnel costs ~100ms, so the
+        # training loop must not fetch per iteration)
+        self._host_models: List[Tree] = []
+        self._pending: List[Tuple[BuiltTree, float, float]] = []
         self.iter = 0
         self.best_iteration = -1
         self.best_score: Dict[str, Dict[str, float]] = {}
@@ -105,7 +110,6 @@ class GBDT:
         self.mesh_ctx = None
         self._row_pad = 0
         if c.tree_learner != "serial":
-            import jax
             from ..parallel.mesh import MeshContext
             if len(jax.devices()) > 1 or c.mesh_shape:
                 self.mesh_ctx = MeshContext(c)
@@ -154,6 +158,31 @@ class GBDT:
         self._weight = train_set.metadata.weight
         self._query = train_set.metadata.query_boundaries
         self._setup_metrics()
+
+        # one jitted tree-build program, traced once per (shapes, params)
+        growth = self.growth
+        if self.mesh_ctx is None:
+            def _raw_build(dd, grad, hess, bag, fmask):
+                return build_tree(dd, grad, hess, growth, bag_mask=bag,
+                                  feature_mask=fmask)
+        else:
+            from ..parallel.learners import build_tree_distributed
+            mesh = self.mesh_ctx.mesh
+            axis = self.mesh_ctx.data_axis
+            lt, tk = c.tree_learner, c.top_k
+
+            def _raw_build(dd, grad, hess, bag, fmask):
+                return build_tree_distributed(
+                    mesh, axis, lt, dd, grad, hess, growth,
+                    bag_mask=bag, feature_mask=fmask, top_k=tk)
+        self._jit_build = jax.jit(_raw_build)
+        # how often the host checks trees for the no-more-splits stop
+        # (reference checks every iteration, gbdt.cpp:435-470; through a
+        # remote tunnel each check is a ~100ms round-trip)
+        default_sync = 1 if jax.default_backend() == "cpu" else 16
+        import os as _os
+        self._sync_freq = int(_os.environ.get("LGBM_TPU_SYNC_FREQ",
+                                              default_sync))
 
     def _setup_metrics(self) -> None:
         c = self.config
@@ -235,67 +264,116 @@ class GBDT:
         g, h = self.objective.get_gradients(self.scores[:, 0])
         return g[:, None], h[:, None]
 
+    # -- lazy host-tree materialization --------------------------------
+    @property
+    def models(self) -> List[Tree]:
+        """Host Tree list; materializes pending device trees on access."""
+        self._flush_pending()
+        return self._host_models
+
+    @models.setter
+    def models(self, value: List[Tree]) -> None:
+        self._pending = []
+        self._host_models = list(value)
+
+    def _num_models(self) -> int:
+        return len(self._host_models) + len(self._pending)
+
+    def _flush_pending(self) -> None:
+        if not self._pending:
+            return
+        from ..utils.timetag import tag
+        with tag("to_host_tree"):
+            # ONE device->host transfer for all pending trees
+            fetched = jax.device_get([p[0] for p in self._pending])
+            for bt_np, lr, bias in ((f, p[1], p[2])
+                                    for f, p in zip(fetched, self._pending)):
+                host = self._to_host_tree(bt_np)
+                host.shrinkage(lr)
+                if bias:
+                    host.add_bias(bias)
+                self._host_models.append(host)
+            self._pending = []
+
     # ------------------------------------------------------------------
     def train_one_iter(self, grad: Optional[jnp.ndarray] = None,
                        hess: Optional[jnp.ndarray] = None) -> bool:
         """One boosting iteration (reference TrainOneIter gbdt.cpp:377-472).
-        Returns True if training should stop (no further splits possible)."""
+        Returns True if training should stop (no further splits possible).
+
+        Stays on device: no host sync per iteration.  The stump check
+        (reference's should_continue) runs every `_sync_freq` iterations;
+        stump trees contribute zero score either way (their leaf value is
+        zeroed device-side, matching the reference's skipped UpdateScore)."""
+        from ..utils.timetag import tag
         c = self.config
-        if grad is None or hess is None:
-            grad, hess = self._gradients()
+        with tag("boosting(grad)") as done:
+            if grad is None or hess is None:
+                grad, hess = self._gradients()
+            done((grad, hess))
         bag = self._bagging_mask(self.iter)
 
-        finished = True
         K = self.num_tree_per_iteration
+        iter_trees = []
         for k in range(K):
             fmask = self._feature_mask()
-            bt = self._build_tree(grad[:, k], hess[:, k], bag, fmask)
-            nl = int(bt.num_leaves)
-            if nl > 1:
-                finished = False
+            with tag("tree") as done:
+                bt = self._build_tree(grad[:, k], hess[:, k], bag, fmask)
+                done(bt.num_leaves)
             bt = self._renew_leaves(bt, k)
-            self._update_scores(bt, k)
-            host = self._to_host_tree(bt)
-            host.shrinkage(self.shrinkage_rate)
-            # bake boost-from-average into the first tree so the serialized
-            # model is self-contained (reference gbdt.cpp:443-445 AddBias)
-            if len(self.models) < K and abs(self.init_score_value) > 1e-15:
-                host.add_bias(self.init_score_value)
-            self.models.append(host)
+            # stump => zero contribution (reference skips UpdateScore and
+            # Shrinkage for num_leaves<=1 trees, gbdt.cpp:435-460)
+            bt = bt._replace(leaf_value=jnp.where(
+                bt.num_leaves > 1, bt.leaf_value,
+                jnp.zeros_like(bt.leaf_value)))
+            iter_trees.append(bt)
+            with tag("score") as done:
+                self._update_scores(bt, k)
+                done(self.scores)
+            bias = (self.init_score_value
+                    if (self._num_models() < K
+                        and abs(self.init_score_value) > 1e-15) else 0.0)
+            # row_leaf ([n]) is only needed for the score update above —
+            # drop it so pending trees don't pin O(iters x n) HBM or ship
+            # dead bytes through the batched device_get
+            self._pending.append((bt._replace(row_leaf=bt.row_leaf[:0]),
+                                  self.shrinkage_rate, bias))
         self.iter += 1
         self._stacked_cache = None
-        if finished:
-            log_warning(f"stopped training because there are no more leaves "
-                        f"that meet the split requirements (iteration "
-                        f"{self.iter})")
-            # drop the stump models of this iteration (reference keeps
-            # semantics: can't learn more)
+
+        finished = False
+        if self._sync_freq > 0 and (self.iter % self._sync_freq == 0):
+            with tag("stump_check"):
+                nls = jax.device_get([bt.num_leaves for bt in iter_trees])
+            if all(int(nl) <= 1 for nl in nls):
+                finished = True
+                # drop this iteration's stump models (gbdt.cpp:462-468)
+                self._pending = self._pending[:-K]
+                self.iter -= 1
+                log_warning(
+                    "stopped training because there are no more leaves "
+                    f"that meet the split requirements (iteration "
+                    f"{self.iter + 1})")
         return finished
 
     def _build_tree(self, grad: jnp.ndarray, hess: jnp.ndarray,
                     bag: Optional[jnp.ndarray],
                     fmask: Optional[jnp.ndarray]) -> BuiltTree:
-        """Dispatch serial vs distributed tree construction."""
-        if self.mesh_ctx is None:
-            return build_tree(self.device_data, grad, hess, self.growth,
-                              bag_mask=bag, feature_mask=fmask)
-        from ..parallel.learners import build_tree_distributed
-        n = self.num_data
-        pad = self._row_pad
-        if bag is None:
-            bag = jnp.ones(n, bool)
-        if pad:
-            grad = jnp.concatenate([grad, jnp.zeros(pad, grad.dtype)])
-            hess = jnp.concatenate([hess, jnp.zeros(pad, hess.dtype)])
-            bag = jnp.concatenate([bag, jnp.zeros(pad, bool)])
-        bt = build_tree_distributed(
-            self.mesh_ctx.mesh, self.mesh_ctx.data_axis,
-            self.config.tree_learner, self.device_data, grad, hess,
-            self.growth, bag_mask=bag, feature_mask=fmask,
-            top_k=self.config.top_k)
-        if pad:
-            bt = bt._replace(row_leaf=bt.row_leaf[:n])
-        return bt
+        """Run the jitted tree build (serial or distributed)."""
+        if self.mesh_ctx is not None:
+            n = self.num_data
+            pad = self._row_pad
+            if bag is None:
+                bag = jnp.ones(n, bool)
+            if pad:
+                grad = jnp.concatenate([grad, jnp.zeros(pad, grad.dtype)])
+                hess = jnp.concatenate([hess, jnp.zeros(pad, hess.dtype)])
+                bag = jnp.concatenate([bag, jnp.zeros(pad, bool)])
+            bt = self._jit_build(self.device_data, grad, hess, bag, fmask)
+            if pad:
+                bt = bt._replace(row_leaf=bt.row_leaf[:n])
+            return bt
+        return self._jit_build(self.device_data, grad, hess, bag, fmask)
 
     def _renew_leaves(self, bt: BuiltTree, k: int) -> BuiltTree:
         """Objective-specific leaf re-fit (RenewTreeOutput,
@@ -318,8 +396,9 @@ class GBDT:
             pred = predict_built_tree(bt, vd, vd.bins)
             self._valid_scores[i] = self._valid_scores[i].at[:, k].add(lr * pred)
 
-    def _to_host_tree(self, bt: BuiltTree) -> Tree:
-        """Device BuiltTree -> host Tree with real-valued thresholds."""
+    def _to_host_tree(self, bt) -> Tree:
+        """Host-side BuiltTree (numpy pytree from ONE device_get) -> Tree
+        with real-valued thresholds."""
         ds = self.train_set
         nl = int(bt.num_leaves)
         t = Tree(max(self.growth.num_leaves, 2))
@@ -470,10 +549,33 @@ class GBDT:
                 path = f"{c.output_model}.snapshot_iter_{it + 1}"
                 self.save_model(path)
                 log_info(f"saved snapshot to {path}")
+        self.trim_trailing_stumps()
+
+    def trim_trailing_stumps(self) -> None:
+        """Drop trailing all-stump iterations (the per-iteration stop check
+        only runs every `_sync_freq` iterations on remote devices, so a run
+        can end with undetected stump trees; reference pops them,
+        gbdt.cpp:462-468)."""
+        K = self.num_tree_per_iteration
+        if not self._pending:
+            return
+        nls = [int(x) for x in
+               jax.device_get([p[0].num_leaves for p in self._pending])]
+        trimmed = 0
+        while (len(nls) >= K
+               and all(nl <= 1 for nl in nls[-K:])):
+            nls = nls[:-K]
+            self._pending = self._pending[:-K]
+            self.iter -= 1
+            trimmed += 1
+        if trimmed:
+            self._stacked_cache = None
+            log_warning(f"dropped {trimmed} trailing iteration(s) with no "
+                        f"splittable leaves")
 
     # ------------------------------------------------------------------
     def num_trees(self) -> int:
-        return len(self.models)
+        return self._num_models()
 
     @property
     def current_iteration(self) -> int:

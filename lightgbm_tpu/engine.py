@@ -51,8 +51,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
     booster = Booster(params=params, train_set=train_set)
     if init_model is not None:
         if isinstance(init_model, str):
-            with open(init_model) as f:
-                init_str = f.read()
+            # a str is either a model filename or the model text itself
+            # (reference Booster accepts both model_file and model_str)
+            if "Tree=" in init_model or "\n" in init_model:
+                init_str = init_model
+            else:
+                with open(init_model) as f:
+                    init_str = f.read()
         elif isinstance(init_model, Booster):
             init_str = init_model.model_to_string()
         else:
@@ -111,6 +116,7 @@ def train(params: Dict[str, Any], train_set: Dataset,
             for name, metric, val, _ in (e.best_score or []):
                 booster.best_score.setdefault(name, {})[metric] = val
             break
+    booster._gbdt.trim_trailing_stumps()
     if booster.best_iteration <= 0:
         booster.best_iteration = booster.current_iteration
     if not keep_training_booster:

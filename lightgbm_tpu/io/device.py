@@ -10,14 +10,21 @@ from __future__ import annotations
 
 from typing import NamedTuple
 
+import jax
 import jax.numpy as jnp
 import numpy as np
 
 from .dataset import BinnedDataset
 
 
+@jax.tree_util.register_pytree_node_class
 class DeviceData(NamedTuple):
-    """Static-shape training data pytree (device arrays + static ints)."""
+    """Static-shape training data pytree (device arrays + static ints).
+
+    Registered as a custom pytree so the static metadata (`total_bins`,
+    `max_bins`, `has_categorical`) stays Python-side across ``jax.jit``
+    boundaries (they parameterize shapes) while the arrays are traced.
+    """
     bins: jnp.ndarray           # [n, F] uint8/int32
     bin_offsets: jnp.ndarray    # [F] int32 offsets into flat bin space
     num_bins: jnp.ndarray       # [F] int32 (includes NaN bin)
@@ -28,6 +35,17 @@ class DeviceData(NamedTuple):
     total_bins: int             # static
     max_bins: int               # static
     has_categorical: bool = True   # static: lets the split scan drop cat work
+
+    def tree_flatten(self):
+        children = (self.bins, self.bin_offsets, self.num_bins,
+                    self.default_bins, self.missing_types,
+                    self.is_categorical, self.nan_bins)
+        aux = (self.total_bins, self.max_bins, self.has_categorical)
+        return children, aux
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, *aux)
 
     @property
     def num_data(self) -> int:

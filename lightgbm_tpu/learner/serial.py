@@ -7,26 +7,29 @@ builds the smaller child's histograms (OpenMP over feature groups), derives
 the sibling by subtraction, scans features for the best split, and
 physically repartitions row indices (`data_partition.hpp`).
 
-Here the whole tree is built by ONE ``lax.while_loop`` of *waves*:
+Here the whole tree is built by ONE ``lax.while_loop`` of *waves*, with the
+reference's histogram-economy strategy kept intact
+(`serial_tree_learner.cpp:358-372`, `feature_histogram.hpp:64-70`):
 
-  1. one histogram pass for ALL current leaves (``build_histograms`` —
-     a single scatter keyed by the row→leaf vector; no data partition,
-     no histogram pool, no ordered bins),
-  2. one vectorized split search for all leaves × features
-     (``find_best_splits``),
-  3. split the top-``wave_size`` leaves by gain in the same wave.
+  1. histogram ONLY the smaller child of every split made in the previous
+     wave (one MXU one-hot-matmul kernel pass over all rows,
+     `ops/pallas_histogram.py`; XLA scatter fallback off-TPU),
+  2. derive each sibling by parent-minus-child subtraction from the
+     persistent per-leaf histogram state ``[L, F, B, 3]`` held in HBM
+     (the HistogramPool analog — no LRU needed, it all fits),
+  3. re-scan ONLY those changed leaves (vectorized two-direction prefix
+     scan, `ops/split.py`) and cache their best splits,
+  4. split every positive-gain leaf (up to ``wave_size``) in one go.
 
 ``wave_size=1`` reproduces the reference's leaf-wise growth decision-for-
-decision (one best-gain leaf per wave).  ``wave_size>=num_leaves`` splits
-every positive-gain leaf per wave — ~log2(num_leaves) histogram passes per
-tree instead of num_leaves−1, the TPU-friendly default (the histogram pass
-costs O(n·F) regardless of how many leaves it serves, so batching splits
-divides the dominant cost by the wave width).
+decision; the default full wave splits all splittable leaves per wave —
+~log2(num_leaves) histogram passes per tree, each touching every row once.
 
 Everything is static-shape: leaf arrays are sized ``[num_leaves]``, tree
-node arrays ``[num_leaves-1]``, and finished trees report a dynamic
-``num_leaves`` scalar.  The same step runs unchanged under ``shard_map``
-for the distributed learners (histograms gain a ``psum``).
+node arrays ``[num_leaves-1]``, active-split slots ``[num_leaves//2]``,
+and finished trees report a dynamic ``num_leaves`` scalar.  The same step
+runs unchanged under ``shard_map`` for the distributed learners (the
+active-leaf histograms gain a ``psum``).
 """
 from __future__ import annotations
 
@@ -38,7 +41,10 @@ import jax.numpy as jnp
 
 from ..io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 from ..io.device import DeviceData
-from ..ops.histogram import build_histograms, pad_to_feature_grid
+from ..ops.pallas_histogram import (bin_stride, default_backend,
+                                    hist_active_pallas, hist_active_scatter,
+                                    pack_values, pallas_config_ok,
+                                    transpose_bins)
 from ..ops.split import SplitParams, SplitResult, find_best_splits
 
 NEG_INF = -1e30
@@ -87,7 +93,26 @@ class _WaveState(NamedTuple):
     leaf_value: jnp.ndarray      # [L] f32
     leaf_parent: jnp.ndarray     # [L] i32 node idx
     leaf_is_left: jnp.ndarray    # [L] bool
+    hist_state: jnp.ndarray      # [L, F_local, B, 3] per-leaf histograms
+    best: SplitResult            # [L] cached best split per leaf
+    act_small: jnp.ndarray       # [A] leaf ids to histogram this wave (-1 pad)
+    act_parent: jnp.ndarray      # [A] slot holding the parent hist (-1: none)
+    act_sibling: jnp.ndarray     # [A] sibling leaf id (-1: none)
     tree: BuiltTree
+
+
+def _empty_best(L: int, B: int) -> SplitResult:
+    z = jnp.zeros(L, jnp.float32)
+    return SplitResult(
+        gain=jnp.full(L, NEG_INF, jnp.float32),
+        feature=jnp.zeros(L, jnp.int32),
+        threshold=jnp.zeros(L, jnp.int32),
+        default_left=jnp.zeros(L, bool),
+        is_categorical=jnp.zeros(L, bool),
+        cat_mask=jnp.zeros((L, B), bool),
+        left_sum_grad=z, left_sum_hess=z, left_count=z,
+        right_sum_grad=z, right_sum_hess=z, right_count=z,
+        left_output=z, right_output=z)
 
 
 def _row_go_left(data: DeviceData, best: SplitResult, row_leaf, rows_feature,
@@ -105,30 +130,97 @@ def _row_go_left(data: DeviceData, best: SplitResult, row_leaf, rows_feature,
     return jnp.where(best.is_categorical[l], cat_left, num_left)
 
 
-def default_splitter(data: DeviceData, grad, hess, params: GrowthParams,
-                     feature_mask, psum_fn=None, hist_fn=build_histograms):
-    """The serial find-splits strategy: histograms for all leaves + one
-    vectorized scan.  Distributed learners swap this closure out (the
-    analog of the reference's learner-template matrix,
-    `tree_learner.cpp:9-33`); `psum_fn` injects the data-parallel
-    histogram collective (`data_parallel_tree_learner.cpp:147-162`)."""
-    L = params.num_leaves
-    B = data.max_bins
+# ---------------------------------------------------------------------------
+# histogram-wave strategies (the learner-type seam, tree_learner.cpp:9-33)
+# ---------------------------------------------------------------------------
+def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
+                 backend: str = "auto", hist_mode: str = "hilo"):
+    """Build the per-wave active-leaf histogram closure
+    ``(hist_leaf, active) -> [A, F, B, 3]``.
 
-    def splitter(hist_leaf, leaf_sum_grad, leaf_sum_hess, leaf_count):
-        hist_flat = hist_fn(data.bins, grad, hess, hist_leaf,
-                            data.bin_offsets, L, data.total_bins)
+    backend "pallas" = the MXU one-hot-matmul kernel (TPU);
+    "scatter" = XLA scatter-add (CPU tests / oracle).  The two are
+    cross-checked by ``tests/test_pallas_hist.py`` the way the reference
+    checks GPU vs CPU histograms (`gpu_tree_learner.cpp:1020-1043`).
+    """
+    if backend == "auto":
+        backend = default_backend()
+    if backend == "pallas" and not pallas_config_ok(
+            data.max_bins, num_leaf_slots, hist_mode):
+        backend = "scatter"     # >256 bins or VMEM-infeasible config
+    if backend == "pallas":
+        bins_t = transpose_bins(data.bins)
+        vals = pack_values(grad, hess, hist_mode)
+        n_pad = bins_t.shape[1]
+        n = data.bins.shape[0]
+
+        def hist_fn(hist_leaf, active):
+            leaf = hist_leaf
+            if n_pad != n:
+                leaf = jnp.pad(hist_leaf, (0, n_pad - n),
+                               constant_values=-1)
+            return hist_active_pallas(
+                bins_t, vals, leaf, active,
+                num_features=data.num_features, max_bins=data.max_bins,
+                mode=hist_mode)
+    else:
+        def hist_fn(hist_leaf, active):
+            return hist_active_scatter(
+                data.bins, grad, hess, hist_leaf, active,
+                max_bins=data.max_bins, num_leaf_slots=num_leaf_slots)
+    return hist_fn
+
+
+def apply_hist_wave(hist_state, new_h, act_small, act_parent, act_sibling,
+                    L: int):
+    """Shared per-wave histogram bookkeeping for every learner strategy:
+    derive each sibling by parent-minus-child subtraction
+    (`feature_histogram.hpp:64-70`), persist both children into the
+    per-leaf state, and hand back the changed-leaf ids + their grids.
+
+    Returns ``(hist_state, ids [2A], grid [2A, F, B, 3])``.  The grid is
+    exactly ``[new_h; sib_h]`` — no re-gather from state; padding slots
+    (id -1) carry garbage and their scan results must be dropped by the
+    caller (they are: the best-split scatter drops ids < 0).
+    """
+    parent_h = hist_state[jnp.clip(act_parent, 0, L - 1)]
+    sib_h = parent_h - new_h                             # [A, F, B, 3]
+    hist_state = hist_state.at[
+        jnp.where(act_small >= 0, act_small, L)].set(new_h, mode="drop")
+    hist_state = hist_state.at[
+        jnp.where(act_sibling >= 0, act_sibling, L)].set(sib_h, mode="drop")
+    ids = jnp.concatenate([act_small, act_sibling])      # [2A]
+    grid = jnp.concatenate([new_h, sib_h], axis=0)       # [2A, F, B, 3]
+    return hist_state, ids, grid
+
+
+def make_serial_strategy(data: DeviceData, grad, hess, params: GrowthParams,
+                         feature_mask, psum_fn=None, backend: str = "auto",
+                         hist_mode: str = "hilo"):
+    """The serial (and data-parallel, via `psum_fn`) wave strategy:
+    histogram the active leaves, subtract siblings, rescan changed leaves.
+
+    `psum_fn` injects the data-parallel histogram collective — the
+    reference's ReduceScatter seam (`data_parallel_tree_learner.cpp:147-162`)
+    collapses to one psum of the active-leaf histograms."""
+    L = params.num_leaves
+    hist_fn = make_hist_fn(data, grad, hess, L, backend, hist_mode)
+
+    def wave(hist_state, hist_leaf, act_small, act_parent, act_sibling,
+             lsg, lsh, lc):
+        new_h = hist_fn(hist_leaf, act_small)            # [A, F, B, 3]
         if psum_fn is not None:
-            hist_flat = psum_fn(hist_flat)
-        grid = pad_to_feature_grid(hist_flat, data.bin_offsets,
-                                   data.num_bins, B)
-        return find_best_splits(grid, leaf_sum_grad, leaf_sum_hess,
-                                leaf_count, data.num_bins,
-                                data.missing_types, data.default_bins,
-                                data.is_categorical, params.split,
-                                feature_mask,
-                                any_categorical=data.has_categorical)
-    return splitter
+            new_h = psum_fn(new_h)
+        hist_state, ids, grid = apply_hist_wave(
+            hist_state, new_h, act_small, act_parent, act_sibling, L)
+        safe = jnp.clip(ids, 0, L - 1)
+        res = find_best_splits(grid, lsg[safe], lsh[safe], lc[safe],
+                               data.num_bins, data.missing_types,
+                               data.default_bins, data.is_categorical,
+                               params.split, feature_mask,
+                               any_categorical=data.has_categorical)
+        return hist_state, ids, res
+    return wave
 
 
 def build_tree(data: DeviceData,
@@ -137,17 +229,21 @@ def build_tree(data: DeviceData,
                params: GrowthParams,
                bag_mask: Optional[jnp.ndarray] = None,
                feature_mask: Optional[jnp.ndarray] = None,
-               hist_fn=build_histograms,
+               strategy=None,
                psum_fn=None,
-               splitter=None) -> BuiltTree:
-    """Grow one tree.  Jittable; `psum_fn` lets distributed learners inject
-    a collective over local histograms (the reference's ReduceScatter seam,
-    `data_parallel_tree_learner.cpp:147-162`); `splitter` replaces the whole
-    find-splits strategy (feature/voting-parallel)."""
+               hist_backend: str = "auto",
+               num_hist_features: Optional[int] = None) -> BuiltTree:
+    """Grow one tree.  Jittable; `psum_fn` lets the data-parallel learner
+    inject a collective over active-leaf histograms; `strategy` replaces
+    the whole wave procedure (feature/voting-parallel,
+    `parallel/learners.py`).  `num_hist_features` overrides the width of
+    the histogram state (feature-parallel shards keep only their slice)."""
     n, F = data.bins.shape
     L = params.num_leaves
     Lm = max(L - 1, 1)
-    B = data.max_bins
+    B = bin_stride(data.max_bins)
+    A = max(1, L // 2)
+    Fh = num_hist_features if num_hist_features is not None else F
 
     row_leaf = jnp.zeros(n, jnp.int32)
     hist_leaf = (jnp.where(bag_mask, 0, -1).astype(jnp.int32)
@@ -183,6 +279,7 @@ def build_tree(data: DeviceData,
     root_out = _leaf_out(sum_g, sum_h, params.split.lambda_l1,
                          params.split.lambda_l2)
 
+    pad_a = jnp.full(A, -1, jnp.int32)
     state = _WaveState(
         row_leaf=row_leaf, hist_leaf=hist_leaf,
         nl=jnp.asarray(1, jnp.int32), done=jnp.asarray(False),
@@ -193,20 +290,34 @@ def build_tree(data: DeviceData,
         leaf_value=jnp.zeros(L, jnp.float32).at[0].set(root_out),
         leaf_parent=jnp.full(L, -1, jnp.int32),
         leaf_is_left=jnp.zeros(L, bool),
+        hist_state=jnp.zeros((L, Fh, B, 3), jnp.float32),
+        best=_empty_best(L, B),
+        act_small=pad_a.at[0].set(0),    # root wave: histogram leaf 0 …
+        act_parent=pad_a,                # … with no parent to subtract from
+        act_sibling=pad_a,
         tree=tree,
     )
 
-    wave = params.wave_size if params.wave_size > 0 else L
-    if splitter is None:
-        splitter = default_splitter(data, grad, hess, params, feature_mask,
-                                    psum_fn=psum_fn, hist_fn=hist_fn)
+    wave_cap = params.wave_size if params.wave_size > 0 else L
+    if strategy is None:
+        strategy = make_serial_strategy(data, grad, hess, params,
+                                        feature_mask, psum_fn=psum_fn,
+                                        backend=hist_backend)
 
     def cond(s: _WaveState):
         return (~s.done) & (s.nl < L)
 
     def body(s: _WaveState) -> _WaveState:
-        best = splitter(s.hist_leaf, s.leaf_sum_grad, s.leaf_sum_hess,
-                        s.leaf_count)
+        # --- 1-3: histogram active leaves, subtract siblings, rescan ----
+        hist_state, ids, res = strategy(
+            s.hist_state, s.hist_leaf, s.act_small, s.act_parent,
+            s.act_sibling, s.leaf_sum_grad, s.leaf_sum_hess, s.leaf_count)
+        best = jax.tree.map(
+            lambda cur, new: cur.at[
+                jnp.where(ids >= 0, ids, L)].set(new, mode="drop"),
+            s.best, res)
+
+        # --- 4: select this wave's splits -------------------------------
         lid = jnp.arange(L)
         gain = jnp.where(lid < s.nl, best.gain, NEG_INF)
         if params.max_depth > 0:
@@ -216,13 +327,14 @@ def build_tree(data: DeviceData,
         order = jnp.argsort(-gain)                      # leaves by gain desc
         rank = jnp.argsort(order)                       # rank[l]
         budget = L - s.nl
-        k = jnp.minimum(jnp.minimum(jnp.sum(can), budget), wave)
+        k = jnp.minimum(jnp.minimum(jnp.sum(can), budget),
+                        jnp.minimum(wave_cap, A))
         sel = can & (rank < k)
 
         new_id = jnp.where(sel, s.nl + rank, L)         # L => drop scatter
         node_idx = jnp.where(sel, s.nl - 1 + rank, Lm)  # Lm => drop scatter
 
-        # --- record tree nodes (scatter at node_idx; drop where unselected)
+        # --- 5: record tree nodes (scatter at node_idx; drop unselected)
         t = s.tree
         dl = jnp.where(best.is_categorical, False, best.default_left)
         t = t._replace(
@@ -253,7 +365,7 @@ def build_tree(data: DeviceData,
             right_child=t.right_child.at[fix_right].set(node_idx, mode="drop"),
         )
 
-        # --- update leaf state: left child keeps id l, right child -> new_id
+        # --- 6: update leaf state: left child keeps id l, right -> new_id
         depth1 = s.leaf_depth + 1
         lsg = jnp.where(sel, best.left_sum_grad, s.leaf_sum_grad)
         lsh = jnp.where(sel, best.left_sum_hess, s.leaf_sum_hess)
@@ -271,7 +383,7 @@ def build_tree(data: DeviceData,
         lp = lp.at[new_id].set(node_idx, mode="drop")
         lil = lil.at[new_id].set(False, mode="drop")
 
-        # --- route rows ------------------------------------------------
+        # --- 7: route rows ----------------------------------------------
         def route(leaf_vec):
             safe = jnp.maximum(leaf_vec, 0)
             f = best.feature[safe]
@@ -284,12 +396,26 @@ def build_tree(data: DeviceData,
         row_leaf2 = route(s.row_leaf)
         hist_leaf2 = route(s.hist_leaf)
 
+        # --- 8: next wave's active sets (smaller child + subtraction) ---
+        # the smaller child gets histogrammed; the sibling is derived from
+        # the parent histogram left in slot l (the left child's id)
+        smaller_left = best.left_count <= best.right_count
+        small_val = jnp.where(smaller_left, lid, new_id)
+        sib_val = jnp.where(smaller_left, new_id, lid)
+        slot = jnp.where(sel, rank, A)
+        act_small = pad_a.at[slot].set(small_val, mode="drop")
+        act_parent = pad_a.at[slot].set(lid, mode="drop")
+        act_sibling = pad_a.at[slot].set(sib_val, mode="drop")
+
         nl2 = s.nl + k
         return _WaveState(
             row_leaf=row_leaf2, hist_leaf=hist_leaf2, nl=nl2,
             done=(k == 0),
             leaf_sum_grad=lsg, leaf_sum_hess=lsh, leaf_count=lc,
             leaf_depth=ld, leaf_value=lv, leaf_parent=lp, leaf_is_left=lil,
+            hist_state=hist_state, best=best,
+            act_small=act_small, act_parent=act_parent,
+            act_sibling=act_sibling,
             tree=t)
 
     final = jax.lax.while_loop(cond, body, state)

@@ -1,0 +1,289 @@
+"""Pallas TPU histogram kernel — one-hot matmuls on the MXU.
+
+The TPU answer to the reference's OpenCL histogram machinery
+(`/root/reference/src/treelearner/ocl/histogram256.cl:94-130` local-memory
+atomic float adds, `src/treelearner/gpu_tree_learner.cpp:581-654` kernel
+variants, `:890-975` async pipeline).  TPUs have no atomics, so the
+scatter-add becomes dense linear algebra:
+
+For one row-tile of ``T`` rows we build, entirely in VMEM,
+
+* ``oh``  ``[F*B, T]``   one-hot of each row's (feature, bin) joint index,
+* ``vw``  ``[T, C*A]``   per-row values ``(grad, hess, 1)`` replicated into
+  the column block of the row's leaf — nonzero only where the row's leaf
+  is in the ``active`` list (the wave's "smaller children",
+  `serial_tree_learner.cpp:358-372`),
+
+and accumulate ``oh @ vw -> [F*B, C*A]`` into a VMEM accumulator over the
+row grid.  The one-hot itself is produced by a tiny MXU matmul
+(``spread.T @ bins`` replicates each feature's bin id across its B output
+rows) followed by one vector compare — no gathers, no cross-lane
+reshapes.
+
+Memory layout notes:
+
+* ``bins_t`` is the binned matrix TRANSPOSED to ``[F, n]`` bfloat16 (bin
+  ids <= 256 are exact in bf16; larger bin counts are routed to the
+  scatter backend by :func:`pallas_config_ok`).  The transpose is done
+  once per tree; the
+  kernel then streams ``[Ft, T]`` blocks with the row dimension on lanes.
+* bins are laid out at a fixed power-of-two stride ``B`` per feature, so
+  the output is directly the padded ``[A, F, B, 3]`` grid the vectorized
+  split scan consumes — no ragged offsets.
+* precision: the one-hot is exact in bf16.  Values are either bf16
+  (``mode="bf16"``, C=3) or split into hi+lo bf16 pairs
+  (``mode="hilo"``, C=5) giving ~f32 accuracy at 5/3 the MACs; counts are
+  exact either way (MXU accumulates in f32).  This mirrors the
+  reference's GPU single-precision trade-off
+  (`docs/GPU-Performance.rst:135-161`).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANE = 128
+DEFAULT_ROW_TILE = 512
+# cap for the [Ft*B, C*A] f32 VMEM accumulator
+_ACC_VMEM_BYTES = 6 * 1024 * 1024
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def bin_stride(max_bins: int) -> int:
+    """Per-feature bin stride used by the kernel's joint index space."""
+    return max(8, _next_pow2(max_bins))
+
+
+def pallas_config_ok(max_bins: int, num_leaves: int, mode: str) -> bool:
+    """Whether the matmul kernel can handle this config exactly.
+
+    * bin ids ride through bf16, exact only up to 256 — larger bin counts
+      (``Dataset`` switches to int32 bins past 256) need the scatter path;
+    * the ``[feat_tile*B, C*A_pad]`` f32 accumulator must fit the minimum
+      feat_tile of 8 within VMEM.
+    """
+    if max_bins > 256:
+        return False
+    B = bin_stride(max_bins)
+    C = 5 if mode == "hilo" else 3
+    A_pad = _round_up(max(max(1, num_leaves // 2), LANE), LANE)
+    return 8 * B * C * A_pad * 4 <= 12 * 1024 * 1024
+
+
+def transpose_bins(bins: jnp.ndarray, row_tile: int = DEFAULT_ROW_TILE,
+                   feat_tile: int | None = None) -> jnp.ndarray:
+    """``[n, F] uint8 -> [F_pad, n_pad] bf16`` once-per-tree input prep."""
+    n, F = bins.shape
+    n_pad = _round_up(n, row_tile)
+    F_pad = _round_up(F, feat_tile or F)
+    out = jnp.zeros((F_pad, n_pad), jnp.bfloat16)
+    return jax.lax.dynamic_update_slice(
+        out, bins.T.astype(jnp.bfloat16), (0, 0))
+
+
+def pack_values(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
+                row_tile: int = DEFAULT_ROW_TILE) -> jnp.ndarray:
+    """Build the per-row value columns ``[n_pad, C]`` once per tree.
+
+    mode="bf16": C=3 ``(g, h, 1)``; mode="hilo": C=5
+    ``(g_hi, g_lo, h_hi, h_lo, 1)`` with ``x == x_hi + x_lo`` to ~2^-17.
+    """
+    n = grad.shape[0]
+    ones = jnp.ones_like(grad)
+    if mode == "hilo":
+        g_hi = grad.astype(jnp.bfloat16).astype(jnp.float32)
+        h_hi = hess.astype(jnp.bfloat16).astype(jnp.float32)
+        cols = [g_hi, grad - g_hi, h_hi, hess - h_hi, ones]
+    else:
+        cols = [grad, hess, ones]
+    vals = jnp.stack(cols, axis=-1).astype(jnp.float32)
+    n_pad = _round_up(n, row_tile)
+    if n_pad != n:
+        vals = jnp.pad(vals, ((0, n_pad - n), (0, 0)))
+    return vals
+
+
+def _spread_matrix(feat_tile: int, B: int) -> np.ndarray:
+    """``[Ft*B, Ft]`` bf16 constant: ``spread[f*B+b, f] = 1``."""
+    s = np.zeros((feat_tile * B, feat_tile), np.float32)
+    for f in range(feat_tile):
+        s[f * B:(f + 1) * B, f] = 1.0
+    return s.astype(jnp.bfloat16)
+
+
+def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref, spread_ref,
+                 out_ref, *, n_cols: int, B: int):
+    """One (feature-tile, row-tile) grid cell; accumulates over row tiles."""
+    rt = pl.program_id(1)
+
+    @pl.when(rt == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    # [Ft*B, T] — each feature's bin id replicated across its B rows
+    binsrep = jnp.dot(spread_ref[:], bins_ref[:],
+                      preferred_element_type=jnp.float32)
+    brow = jax.lax.broadcasted_iota(
+        jnp.int32, binsrep.shape, 0) & (B - 1)
+    oh = (binsrep == brow.astype(jnp.float32)).astype(jnp.bfloat16)
+
+    # [T, A] leaf membership mask over the active-leaf list
+    m = (leaf_ref[:] == active_ref[:]).astype(jnp.bfloat16)
+    vals = vals_ref[:]                                       # [T, C] f32
+    vw = jnp.concatenate(
+        [m * vals[:, c:c + 1].astype(jnp.bfloat16) for c in range(n_cols)],
+        axis=1)                                              # [T, C*A]
+
+    out_ref[:] += jax.lax.dot_general(
+        oh, vw, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_features", "max_bins", "mode", "row_tile",
+                     "interpret"))
+def hist_active_pallas(bins_t: jnp.ndarray,
+                       vals: jnp.ndarray,
+                       row_leaf: jnp.ndarray,
+                       active: jnp.ndarray,
+                       *,
+                       num_features: int,
+                       max_bins: int,
+                       mode: str = "hilo",
+                       row_tile: int = DEFAULT_ROW_TILE,
+                       interpret: bool = False) -> jnp.ndarray:
+    """Histograms for the active leaves: ``-> [A, F, B, 3]`` float32.
+
+    Args:
+      bins_t: ``[F_pad, n_pad]`` bf16 transposed binned matrix
+        (:func:`transpose_bins`).
+      vals: ``[n_pad, C]`` f32 packed value columns (:func:`pack_values`).
+      row_leaf: ``[n]`` int32 leaf per row; rows whose leaf is not in
+        `active` (including bagged-out ``-1``) contribute nothing.
+      active: ``[A]`` int32 leaf ids to histogram; ``-1`` entries are
+        padding (their output slots contain garbage from bagged-out rows
+        and must be dropped by the caller).
+      num_features: true F (<= F_pad).
+      max_bins: true per-feature bin-count bound; output B = its stride.
+
+    Returns:
+      ``[A, F, B, 3]`` f32 with B = ``bin_stride(max_bins)``, cells
+      ``(sum_grad, sum_hess, count)``.
+    """
+    F_pad, n_pad = bins_t.shape
+    C = vals.shape[1]
+    A = active.shape[0]
+    B = bin_stride(max_bins)
+    T = row_tile
+    assert n_pad % T == 0, (n_pad, T)
+
+    A_pad = _round_up(max(A, LANE), LANE)
+    # feature tile: bounded by the f32 accumulator's VMEM budget; when
+    # tiling, the block's sublane dim must be a multiple of 8 (Mosaic
+    # tiling constraint — a full-array block is exempt)
+    ft_cap = max(1, _ACC_VMEM_BYTES // (B * C * A_pad * 4))
+    if ft_cap >= F_pad:
+        feat_tile = F_pad
+    else:
+        feat_tile = max(8, (ft_cap // 8) * 8)
+    F_grid = _round_up(F_pad, feat_tile)
+    if F_grid != F_pad:
+        bins_t = jnp.pad(bins_t, ((0, F_grid - F_pad), (0, 0)))
+
+    leaf = jnp.full((n_pad, 1), -1, jnp.int32)
+    leaf = jax.lax.dynamic_update_slice(
+        leaf, row_leaf.astype(jnp.int32)[:, None], (0, 0))
+    act = jnp.full((1, A_pad), -2, jnp.int32)
+    act = jax.lax.dynamic_update_slice(
+        act, active.astype(jnp.int32)[None, :], (0, 0))
+    # padded rows carry leaf -1; bagged-out rows carry -1 too.  Use -2 for
+    # active padding so neither lands in a real column block; -1 actives
+    # (wave padding) DO accumulate bagged-out rows, caller drops them.
+    spread = jnp.asarray(_spread_matrix(feat_tile, B))
+
+    grid = (F_grid // feat_tile, n_pad // T)
+    out = pl.pallas_call(
+        functools.partial(_hist_kernel, n_cols=C, B=B),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, A_pad), lambda f, r: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((feat_tile, T), lambda f, r: (f, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, C), lambda f, r: (r, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, 1), lambda f, r: (r, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((feat_tile * B, feat_tile), lambda f, r: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((feat_tile * B, C * A_pad),
+                               lambda f, r: (f, 0),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((F_grid * B, C * A_pad), jnp.float32),
+        interpret=interpret,
+    )(act, bins_t, vals, leaf, spread)
+
+    # [F_grid*B, C*A_pad] -> [A, F, B, C'] -> combine hi/lo -> [A, F, B, 3]
+    out = out.reshape(F_grid, B, C, A_pad)
+    out = out.transpose(3, 0, 1, 2)[:A, :num_features]       # [A, F, B, C]
+    if C == 5:
+        g = out[..., 0] + out[..., 1]
+        h = out[..., 2] + out[..., 3]
+        out = jnp.stack([g, h, out[..., 4]], axis=-1)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# XLA scatter reference implementation (CPU path + equivalence oracle)
+# ---------------------------------------------------------------------------
+def hist_active_scatter(bins: jnp.ndarray,
+                        grad: jnp.ndarray,
+                        hess: jnp.ndarray,
+                        row_leaf: jnp.ndarray,
+                        active: jnp.ndarray,
+                        *,
+                        max_bins: int,
+                        num_leaf_slots: int) -> jnp.ndarray:
+    """Same contract as :func:`hist_active_pallas` (exact f32 scatter),
+    from the untransposed ``[n, F]`` integer bins.  The direct analog of
+    the reference CPU construction (`dataset.cpp:587-752`) restricted to
+    the active leaves."""
+    n, F = bins.shape
+    A = active.shape[0]
+    B = bin_stride(max_bins)
+    L = num_leaf_slots
+    safe_act = jnp.where(active >= 0, active, L)
+    inv = jnp.full((L + 1,), A, jnp.int32).at[safe_act].set(
+        jnp.arange(A, dtype=jnp.int32), mode="drop")
+    slot = jnp.where(row_leaf >= 0,
+                     inv[jnp.clip(row_leaf, 0, L)], A)       # [n]
+    idx = (slot[:, None] * (F * B)
+           + jnp.arange(F, dtype=jnp.int32)[None, :] * B
+           + bins.astype(jnp.int32))                         # [n, F]
+    vals = jnp.stack([grad, hess, jnp.ones_like(grad)], -1)  # [n, 3]
+    hist = jnp.zeros((A * F * B, 3), jnp.float32)
+    hist = hist.at[idx].add(vals[:, None, :].astype(jnp.float32),
+                            mode="drop")
+    return hist.reshape(A, F, B, 3)
+
+
+def default_backend() -> str:
+    forced = os.environ.get("LGBM_TPU_HIST_BACKEND", "")
+    if forced:
+        return forced
+    return "pallas" if jax.default_backend() == "tpu" else "scatter"

@@ -5,26 +5,29 @@ TPU-native redesign of the reference parallel learners
 `data_parallel_tree_learner.cpp`, `voting_parallel_tree_learner.cpp`,
 shared sync helpers `parallel_tree_learner.h:184-207`).  The reference
 couples each strategy to socket/MPI collectives; here each strategy is a
-*splitter closure* run inside one ``shard_map`` over a
-``jax.sharding.Mesh``, with XLA collectives on ICI/DCN:
+*wave closure* (histogram the active leaves → subtract siblings → rescan)
+run inside one ``shard_map`` over a ``jax.sharding.Mesh``, with XLA
+collectives on ICI/DCN:
 
-* **data-parallel** — rows sharded; local histograms merged with
-  ``lax.psum`` (the ReduceScatter+owner-scan of
-  `data_parallel_tree_learner.cpp:147-162` collapses to one collective:
-  XLA schedules the reduce; every shard then scans all features, which on
-  TPU costs less than the comm it would save to partition them).
+* **data-parallel** — rows sharded; local active-leaf histograms merged
+  with ``lax.psum`` (the ReduceScatter+owner-scan of
+  `data_parallel_tree_learner.cpp:147-162` collapses to one collective of
+  the wave's ``[A, F, B, 3]`` block — the smaller-child scheduling halves
+  the reference's wire bytes the same way it halves its FLOPs).
 * **feature-parallel** — rows replicated, feature columns statically
   sliced per shard (`feature_parallel_tree_learner.cpp:31-50`'s
-  load-balance partition becomes an equal static slice); local best
-  splits are ``all_gather``-ed and the global argmax-by-gain picked
-  everywhere (the ``SyncUpGlobalBestSplit`` max-by-gain reducer,
+  load-balance partition becomes an equal static slice); each shard keeps
+  histogram state only for its own columns; local best splits are
+  ``all_gather``-ed and the global argmax-by-gain picked everywhere (the
+  ``SyncUpGlobalBestSplit`` max-by-gain reducer,
   `parallel_tree_learner.h:184-207`).
-* **voting-parallel (PV-Tree)** — rows sharded; each shard votes its
-  top-k features per leaf by local gain; votes are ``all_gather``-ed and
-  the 2k global winners selected by summed local gains
-  (`voting_parallel_tree_learner.cpp:164-193` GlobalVoting); only the
-  winners' histogram columns are ``psum``-ed (comm O(L·2k·B) instead of
-  O(L·F·B)), then the final scan runs on the merged columns.
+* **voting-parallel (PV-Tree)** — rows sharded; histogram state stays
+  local; each shard votes its top-k features per changed leaf by local
+  gain; votes are ``psum``-ed and the 2k global winners selected by
+  summed local gains (`voting_parallel_tree_learner.cpp:164-193`
+  GlobalVoting); only the winners' histogram columns are ``psum``-ed
+  (comm O(2A·2k·B) instead of O(2A·F·B)), then the final scan runs on
+  the merged columns.
 
 All three return bit-identical trees on every shard (the reference's
 distributed-determinism requirement, `application.cpp:249-254`).
@@ -40,148 +43,154 @@ import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..io.device import DeviceData
-from ..learner.serial import (BuiltTree, GrowthParams, build_tree,
-                              default_splitter)
-from ..ops.histogram import build_histograms, pad_to_feature_grid
+from ..learner.serial import (BuiltTree, GrowthParams, apply_hist_wave,
+                              build_tree, make_hist_fn)
+from ..ops.pallas_histogram import bin_stride
 from ..ops.split import (K_MIN_SCORE, SplitParams, SplitResult,
                          find_best_splits)
 
 
-# ---------------------------------------------------------------------------
-# splitter strategies (run inside shard_map)
-# ---------------------------------------------------------------------------
 def _psum(axis):
     return lambda x: jax.lax.psum(x, axis)
-
-
-def make_feature_parallel_splitter(data: DeviceData, grad, hess,
-                                   params: GrowthParams, feature_mask,
-                                   axis: str, num_shards: int):
-    """Features statically sliced per shard; global best via
-    all_gather + argmax-by-gain."""
-    F = data.num_features
-    f_local = -(-F // num_shards)          # ceil
-    L = params.num_leaves
-    B = data.max_bins
-
-    def splitter(hist_leaf, lsg, lsh, lc):
-        idx = jax.lax.axis_index(axis)
-        start = idx * f_local
-        # static-size slice of this shard's feature columns (clamped at end;
-        # the overlap is masked out below)
-        start = jnp.minimum(start, F - f_local)
-        bins_loc = jax.lax.dynamic_slice_in_dim(data.bins, start, f_local, 1)
-        off_loc = jax.lax.dynamic_slice_in_dim(data.bin_offsets, start, f_local)
-        nb_loc = jax.lax.dynamic_slice_in_dim(data.num_bins, start, f_local)
-        db_loc = jax.lax.dynamic_slice_in_dim(data.default_bins, start, f_local)
-        mt_loc = jax.lax.dynamic_slice_in_dim(data.missing_types, start, f_local)
-        ic_loc = jax.lax.dynamic_slice_in_dim(data.is_categorical, start, f_local)
-        # local offsets into a compact local bin space
-        off_compact = jnp.concatenate(
-            [jnp.zeros(1, jnp.int32), jnp.cumsum(nb_loc)[:-1]]).astype(jnp.int32)
-        total_loc = f_local * B            # static upper bound
-        hist_flat = build_histograms(bins_loc, grad, hess, hist_leaf,
-                                     off_compact, L, total_loc)
-        grid = pad_to_feature_grid(hist_flat, off_compact, nb_loc, B)
-        # mask features overlapping a previous shard (end-clamp duplicates)
-        fid_global = start + jnp.arange(f_local)
-        owned = fid_global >= idx * f_local
-        fmask = owned
-        if feature_mask is not None:
-            fmask = fmask & jax.lax.dynamic_slice_in_dim(
-                feature_mask, start, f_local)
-        best = find_best_splits(grid, lsg, lsh, lc, nb_loc, mt_loc, db_loc,
-                                ic_loc, params.split, fmask,
-                                any_categorical=data.has_categorical)
-        best = best._replace(feature=(best.feature + start).astype(jnp.int32))
-        return _sync_global_best(best, axis)
-    return splitter
 
 
 def _sync_global_best(best: SplitResult, axis: str) -> SplitResult:
     """All-gather per-leaf SplitResults and keep the max-gain one — the
     ``SyncUpGlobalBestSplit`` reducer (`parallel_tree_learner.h:184-207`)."""
     gathered = jax.tree.map(
-        lambda a: jax.lax.all_gather(a, axis), best)      # [S, L, ...]
-    win = jnp.argmax(gathered.gain, axis=0)               # [L]
+        lambda a: jax.lax.all_gather(a, axis), best)      # [S, 2A, ...]
+    win = jnp.argmax(gathered.gain, axis=0)               # [2A]
 
     def pick(a):
-        # a: [S, L, ...] -> [L, ...] taking shard win[l] per leaf
         l = jnp.arange(a.shape[1])
         return a[win, l]
 
     return jax.tree.map(pick, gathered)
 
 
-def make_voting_parallel_splitter(data: DeviceData, grad, hess,
+# ---------------------------------------------------------------------------
+# feature-parallel
+# ---------------------------------------------------------------------------
+def make_feature_parallel_strategy(data: DeviceData, grad, hess,
+                                   params: GrowthParams, feature_mask,
+                                   axis: str, num_shards: int,
+                                   hist_backend: str = "auto"):
+    """Features statically sliced per shard; per-shard histogram state
+    covers only the local columns; global best via all_gather + argmax."""
+    F = data.num_features
+    f_local = -(-F // num_shards)          # ceil
+    L = params.num_leaves
+
+    idx = jax.lax.axis_index(axis)
+    start = jnp.minimum(idx * f_local, F - f_local)
+    bins_loc = jax.lax.dynamic_slice_in_dim(data.bins, start, f_local, 1)
+    nb_loc = jax.lax.dynamic_slice_in_dim(data.num_bins, start, f_local)
+    db_loc = jax.lax.dynamic_slice_in_dim(data.default_bins, start, f_local)
+    mt_loc = jax.lax.dynamic_slice_in_dim(data.missing_types, start, f_local)
+    ic_loc = jax.lax.dynamic_slice_in_dim(data.is_categorical, start, f_local)
+    nanb_loc = jax.lax.dynamic_slice_in_dim(data.nan_bins, start, f_local)
+    off_loc = jnp.zeros(f_local, jnp.int32)   # unused by the padded grid
+    data_loc = DeviceData(bins_loc, off_loc, nb_loc, db_loc, mt_loc, ic_loc,
+                          nanb_loc, data.total_bins, data.max_bins,
+                          data.has_categorical)
+    hist_fn = make_hist_fn(data_loc, grad, hess, L, hist_backend)
+
+    # mask features overlapping a previous shard (end-clamp duplicates)
+    fid_global = start + jnp.arange(f_local)
+    owned = fid_global >= idx * f_local
+    fmask = owned
+    if feature_mask is not None:
+        fmask = fmask & jax.lax.dynamic_slice_in_dim(
+            feature_mask, start, f_local)
+
+    def wave(hist_state, hist_leaf, act_small, act_parent, act_sibling,
+             lsg, lsh, lc):
+        new_h = hist_fn(hist_leaf, act_small)            # [A, f_local, B, 3]
+        hist_state, ids, grid = apply_hist_wave(
+            hist_state, new_h, act_small, act_parent, act_sibling, L)
+        safe = jnp.clip(ids, 0, L - 1)
+        best = find_best_splits(grid, lsg[safe], lsh[safe], lc[safe],
+                                nb_loc, mt_loc, db_loc, ic_loc,
+                                params.split, fmask,
+                                any_categorical=data.has_categorical)
+        best = best._replace(feature=(best.feature + start).astype(jnp.int32))
+        return hist_state, ids, _sync_global_best(best, axis)
+
+    return wave, f_local
+
+
+# ---------------------------------------------------------------------------
+# voting-parallel (PV-Tree)
+# ---------------------------------------------------------------------------
+def make_voting_parallel_strategy(data: DeviceData, grad, hess,
                                   params: GrowthParams, feature_mask,
-                                  axis: str, num_shards: int, top_k: int):
-    """PV-Tree: local vote -> global top-2k features -> psum only their
-    histogram columns -> final scan (voting_parallel_tree_learner.cpp)."""
+                                  axis: str, num_shards: int, top_k: int,
+                                  hist_backend: str = "auto"):
+    """PV-Tree: local active-leaf hists -> local vote -> global top-2k
+    features -> psum only their histogram columns -> final scan."""
     F = data.num_features
     L = params.num_leaves
-    B = data.max_bins
     k2 = min(2 * top_k, F)
+    hist_fn = make_hist_fn(data, grad, hess, L, hist_backend)
+    # local constraints scaled 1/S like the reference
+    # (voting_parallel_tree_learner.cpp:55-56)
+    local_params = params.split._replace(
+        min_data_in_leaf=max(1, params.split.min_data_in_leaf // num_shards),
+        min_sum_hessian_in_leaf=params.split.min_sum_hessian_in_leaf
+        / num_shards)
 
-    def splitter(hist_leaf, lsg, lsh, lc):
-        hist_flat = build_histograms(data.bins, grad, hess, hist_leaf,
-                                     data.bin_offsets, L, data.total_bins)
-        grid = pad_to_feature_grid(hist_flat, data.bin_offsets,
-                                   data.num_bins, B)        # [L, F, B, 3]
-        # local per-(leaf, feature) gains for voting: reuse the scan but
-        # with local (1/S-scaled) constraints like the reference
-        # (voting_parallel_tree_learner.cpp:55-56)
-        local_params = params.split._replace(
-            min_data_in_leaf=max(1, params.split.min_data_in_leaf
-                                 // num_shards),
-            min_sum_hessian_in_leaf=params.split.min_sum_hessian_in_leaf
-            / num_shards)
+    def wave(hist_state, hist_leaf, act_small, act_parent, act_sibling,
+             lsg, lsh, lc):
+        new_h = hist_fn(hist_leaf, act_small)            # local histograms
+        hist_state, ids, grid = apply_hist_wave(
+            hist_state, new_h, act_small, act_parent, act_sibling, L)
+        safe = jnp.clip(ids, 0, L - 1)
         # local leaf totals from the local histogram (feature 0's bins
         # contain every in-bag local row exactly once)
         loc_sum_g = jnp.sum(grid[:, 0, :, 0], axis=-1)
         loc_sum_h = jnp.sum(grid[:, 0, :, 1], axis=-1)
         loc_cnt = jnp.sum(grid[:, 0, :, 2], axis=-1)
-        local_best_gain = _per_feature_gains(
-            grid, loc_sum_g, loc_sum_h, loc_cnt, data, local_params,
-            feature_mask)                                    # [L, F]
-        # top-k features per leaf locally
-        _, local_top = jax.lax.top_k(local_best_gain, min(top_k, F))  # [L, k]
-        votes = jnp.zeros((L, F)).at[
-            jnp.arange(L)[:, None], local_top].add(
-            jnp.take_along_axis(local_best_gain, local_top, axis=1))
+        local_gain = _per_feature_gains(grid, loc_sum_g, loc_sum_h, loc_cnt,
+                                        data, local_params, feature_mask)
+        # top-k features per changed leaf locally, weighted-gain votes
+        _, local_top = jax.lax.top_k(local_gain, min(top_k, F))
+        votes = jnp.zeros(local_gain.shape).at[
+            jnp.arange(local_gain.shape[0])[:, None], local_top].add(
+            jnp.take_along_axis(local_gain, local_top, axis=1))
         votes = jnp.where(jnp.isfinite(votes) & (votes > K_MIN_SCORE / 2),
                           votes, 0.0)
-        votes = jax.lax.psum(votes, axis)                    # weighted votes
-        _, sel_feats = jax.lax.top_k(votes, k2)              # [L, k2] global
+        votes = jax.lax.psum(votes, axis)                # GlobalVoting
+        _, sel_feats = jax.lax.top_k(votes, k2)          # [2A, k2]
         # psum ONLY the selected features' histogram columns
         sel_grid = jnp.take_along_axis(
-            grid, sel_feats[:, :, None, None], axis=1)       # [L, k2, B, 3]
+            grid, sel_feats[:, :, None, None], axis=1)   # [2A, k2, B, 3]
         sel_grid = jax.lax.psum(sel_grid, axis)
-        nb = data.num_bins[sel_feats]                        # [L, k2]
+        nb = data.num_bins[sel_feats]
         mt = data.missing_types[sel_feats]
         db = data.default_bins[sel_feats]
         ic = data.is_categorical[sel_feats]
         best = _find_best_per_leaf_features(
-            sel_grid, lsg, lsh, lc, nb, mt, db, ic, params.split,
-            data.has_categorical)
-        # map local (within-selection) feature index back to global
+            sel_grid, lsg[safe], lsh[safe], lc[safe], nb, mt, db, ic,
+            params.split, data.has_categorical)
         gfeat = jnp.take_along_axis(sel_feats, best.feature[:, None],
                                     axis=1)[:, 0]
-        return best._replace(feature=gfeat.astype(jnp.int32))
-    return splitter
+        return hist_state, ids, best._replace(
+            feature=gfeat.astype(jnp.int32))
+
+    return wave
 
 
 def _per_feature_gains(grid, lsg, lsh, lc, data: DeviceData,
                        sp: SplitParams, feature_mask):
-    """Best gain per (leaf, feature) — the voting criterion.  A simplified
-    (numerical, missing-right) scan: votes only need a ranking, the exact
-    scan runs later on the merged winners."""
+    """Best gain per (changed-leaf, feature) — the voting criterion.  A
+    simplified (numerical, missing-right) scan: votes only need a ranking,
+    the exact scan runs later on the merged winners."""
     from ..ops.split import _split_gain, leaf_split_gain
     g = grid[..., 0]; h = grid[..., 1]; c = grid[..., 2]
-    tg = lsg[:, None, None]; th = lsh[:, None, None]; tc = lc[:, None, None]
     clg = jnp.cumsum(g, axis=-1)
     clh = jnp.cumsum(h, axis=-1)
     clc = jnp.cumsum(c, axis=-1)
+    tg = lsg[:, None, None]; th = lsh[:, None, None]; tc = lc[:, None, None]
     gains = _split_gain(clg, clh, tg - clg, th - clh,
                         sp.lambda_l1, sp.lambda_l2)
     ok = ((clc >= sp.min_data_in_leaf) & (tc - clc >= sp.min_data_in_leaf)
@@ -211,13 +220,14 @@ def _find_best_per_leaf_features(sel_grid, lsg, lsh, lc, nb, mt, db, ic,
 
 
 # ---------------------------------------------------------------------------
-# shard_map drivers
+# shard_map driver
 # ---------------------------------------------------------------------------
 def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
                            data: DeviceData, grad, hess,
                            params: GrowthParams,
                            bag_mask=None, feature_mask=None,
-                           top_k: int = 20) -> BuiltTree:
+                           top_k: int = 20,
+                           hist_backend: str = "auto") -> BuiltTree:
     """Run one tree build as an SPMD program over `mesh`.
 
     Row-sharded inputs (data/voting): ``bins``, ``grad``, ``hess``,
@@ -242,22 +252,26 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
     def step(bins, offs, nb, db, mt, ic, nanb, grad_l, hess_l, bag_l,
              fmask_l):
         data_l = DeviceData(bins, offs, nb, db, mt, ic, nanb, *statics)
+        nhf = None
         if learner_type == "data":
-            splitter = default_splitter(data_l, grad_l, hess_l, params,
-                                        fmask_l, psum_fn=_psum(axis))
+            strategy = None        # serial strategy + histogram psum
+            psum_fn = _psum(axis)
         elif learner_type == "feature":
-            splitter = make_feature_parallel_splitter(
-                data_l, grad_l, hess_l, params, fmask_l, axis, num_shards)
-        elif learner_type == "voting":
-            splitter = make_voting_parallel_splitter(
+            strategy, nhf = make_feature_parallel_strategy(
                 data_l, grad_l, hess_l, params, fmask_l, axis, num_shards,
-                top_k)
+                hist_backend)
+            psum_fn = None
+        elif learner_type == "voting":
+            strategy = make_voting_parallel_strategy(
+                data_l, grad_l, hess_l, params, fmask_l, axis, num_shards,
+                top_k, hist_backend)
+            psum_fn = _psum(axis)
         else:
             raise ValueError(learner_type)
-        psum_fn = _psum(axis) if row_shard else None
         return build_tree(data_l, grad_l, hess_l, params, bag_mask=bag_l,
-                          feature_mask=fmask_l, splitter=splitter,
-                          psum_fn=psum_fn)
+                          feature_mask=fmask_l, strategy=strategy,
+                          psum_fn=psum_fn, hist_backend=hist_backend,
+                          num_hist_features=nhf)
 
     out_spec = BuiltTree(
         feature=P(), threshold_bin=P(), default_left=P(), is_categorical=P(),

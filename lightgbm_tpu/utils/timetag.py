@@ -1,0 +1,96 @@
+"""TIMETAG-style phase timers.
+
+The reference accumulates per-phase wall time behind a compile-time
+``TIMETAG`` flag and prints totals at shutdown — tree-learner phases in
+`/root/reference/src/treelearner/serial_tree_learner.cpp:12-39` and
+boosting phases in `src/boosting/gbdt.cpp:22-63`.  Here the same idea is a
+runtime switch (``LGBM_TPU_TIMETAG=1``): named accumulators, a context
+manager that optionally blocks on device arrays so async dispatch does not
+hide the cost, and an atexit report.
+
+Device caveat: JAX dispatch is asynchronous, so phases that launch device
+work must pass the resulting arrays to ``tag(...)`` (or call
+``jax.block_until_ready`` themselves) for the number to mean anything.
+"""
+from __future__ import annotations
+
+import atexit
+import collections
+import os
+import time
+from contextlib import contextmanager
+
+_acc = collections.defaultdict(float)
+_cnt = collections.defaultdict(int)
+_registered = False
+
+
+def enabled() -> bool:
+    return os.environ.get("LGBM_TPU_TIMETAG", "0") not in ("", "0", "false")
+
+
+def _block(x):
+    try:
+        import jax
+        jax.block_until_ready(x)
+    except Exception:
+        pass
+
+
+@contextmanager
+def tag(name: str, sync=None):
+    """Accumulate wall time of the enclosed block under `name`.
+
+    `sync`: optional array/pytree produced *before* the block whose
+    completion should be awaited first (so the previous phase's async work
+    is not billed to this one).  Inside, the block should itself block on
+    its outputs (or pass them through ``done``).
+    """
+    if not enabled():
+        yield _noop
+        return
+    _ensure_report()
+    if sync is not None:
+        _block(sync)
+    t0 = time.perf_counter()
+    out = []
+    try:
+        yield out.append
+    finally:
+        if out:
+            _block(out)
+        _acc[name] += time.perf_counter() - t0
+        _cnt[name] += 1
+
+
+def _noop(*_a):
+    return None
+
+
+def add(name: str, seconds: float) -> None:
+    if enabled():
+        _ensure_report()
+        _acc[name] += seconds
+        _cnt[name] += 1
+
+
+def report() -> str:
+    total = sum(_acc.values())
+    lines = ["[LightGBM-TPU] [TIMETAG] phase timings:"]
+    for name, sec in sorted(_acc.items(), key=lambda kv: -kv[1]):
+        pct = 100.0 * sec / total if total else 0.0
+        lines.append(f"  {name:<24s} {sec:10.3f}s  {pct:5.1f}%  "
+                     f"(n={_cnt[name]})")
+    return "\n".join(lines)
+
+
+def reset() -> None:
+    _acc.clear()
+    _cnt.clear()
+
+
+def _ensure_report() -> None:
+    global _registered
+    if not _registered:
+        _registered = True
+        atexit.register(lambda: print(report()))

@@ -86,12 +86,22 @@ def test_categorical_feature():
          + 0.1 * noise).astype(np.float32)
     X = np.stack([cat, rng.normal(size=n)], 1)
     train = lgb.Dataset(X, label=y, categorical_feature=[0])
+    # lr/rounds sized so shrinkage converges: residual factor 0.7^30 ~ 2e-5
+    # (at lr=0.1 x 10 rounds even a perfect model keeps MSE ~ 0.127)
     bst = lgb.train({"objective": "regression", "metric": "l2",
                      "num_leaves": 7, "min_data_in_leaf": 5,
-                     "min_data_per_group": 1}, train, 10, verbose_eval=False)
+                     "learning_rate": 0.3,
+                     "min_data_per_group": 1}, train, 30, verbose_eval=False)
     p = bst.predict(X)
     # categorical split should separate the two groups nearly perfectly
     assert np.mean((p - y) ** 2) < 0.05
+    # structural gate: the first tree must split the categorical feature
+    # at the root with a many-vs-many bitset (decision_type cat bit,
+    # reference tree.h decision_type semantics)
+    t0 = bst._gbdt.models[0]
+    assert t0.num_cat >= 1
+    assert bool(t0.decision_type[0] & 1)
+    assert int(t0.split_feature[0]) == 0
 
 
 def test_multiclass():

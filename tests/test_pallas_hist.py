@@ -1,0 +1,74 @@
+"""Pallas histogram kernel vs XLA scatter oracle.
+
+The analog of the reference's GPU_DEBUG_COMPARE CPU-vs-GPU histogram
+check (`/root/reference/src/treelearner/gpu_tree_learner.cpp:1020-1043`):
+the MXU one-hot-matmul kernel must reproduce the exact-f32 scatter within
+hi/lo-bf16 tolerance, with exact counts.  Runs in Pallas interpret mode so
+it works on the CPU test mesh.
+"""
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.ops.pallas_histogram import (
+    bin_stride, hist_active_pallas, hist_active_scatter, pack_values,
+    transpose_bins)
+
+
+@pytest.mark.parametrize("max_bins,F,mode", [
+    (63, 28, "hilo"),
+    (63, 28, "bf16"),
+    (255, 10, "hilo"),     # forces feature tiling (acc VMEM budget)
+])
+def test_kernel_matches_scatter(max_bins, F, mode):
+    rng = np.random.RandomState(7)
+    n, L, A = 3000, 31, 15
+    bins = rng.randint(0, max_bins, size=(n, F)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    # include bagged-out rows (-1) and leaves not in the active list
+    row_leaf = rng.randint(-1, L, size=n).astype(np.int32)
+    active = np.full(A, -1, np.int32)
+    active[:10] = rng.choice(L, 10, replace=False)
+
+    bins_j = jnp.asarray(bins)
+    out_p = hist_active_pallas(
+        transpose_bins(bins_j), pack_values(jnp.asarray(grad),
+                                            jnp.asarray(hess), mode),
+        jnp.asarray(row_leaf), jnp.asarray(active),
+        num_features=F, max_bins=max_bins, mode=mode, interpret=True)
+    out_s = hist_active_scatter(
+        bins_j, jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(row_leaf), jnp.asarray(active),
+        max_bins=max_bins, num_leaf_slots=L)
+    p = np.asarray(out_p)[:10]
+    s = np.asarray(out_s)[:10]
+    assert p.shape == s.shape == (10, F, bin_stride(max_bins), 3)
+    # counts are exact in any mode (0/1 one-hot, f32 accumulate)
+    np.testing.assert_array_equal(p[..., 2], s[..., 2])
+    tol = 5e-4 if mode == "hilo" else 2e-2
+    scale = np.abs(s[..., :2]).max() + 1e-9
+    np.testing.assert_allclose(p[..., :2] / scale, s[..., :2] / scale,
+                               atol=tol)
+
+
+def test_scatter_drops_inactive_and_padding():
+    rng = np.random.RandomState(3)
+    n, F, L = 500, 4, 7
+    max_bins = 15
+    bins = rng.randint(0, max_bins, size=(n, F)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = np.ones(n, np.float32)
+    row_leaf = rng.randint(0, L, size=n).astype(np.int32)
+    active = np.array([3, -1, 5], np.int32)
+    out = np.asarray(hist_active_scatter(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(row_leaf), jnp.asarray(active),
+        max_bins=max_bins, num_leaf_slots=L))
+    # slot 0 == leaf 3, slot 2 == leaf 5; counts match the leaf sizes
+    for slot, leaf in ((0, 3), (2, 5)):
+        expect = float((row_leaf == leaf).sum())
+        assert out[slot, 0, :, 2].sum() == expect
+    # padding slot accumulates nothing from in-bag rows
+    assert out[1].sum() == 0.0
