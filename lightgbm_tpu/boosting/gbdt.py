@@ -175,7 +175,9 @@ class GBDT:
                 return build_tree_distributed(
                     mesh, axis, lt, dd, grad, hess, growth,
                     bag_mask=bag, feature_mask=fmask, top_k=tk)
+        self._raw_build = _raw_build
         self._jit_build = jax.jit(_raw_build)
+        self._batch_fns: Dict[int, object] = {}
         # how often the host checks trees for the no-more-splits stop
         # (reference checks every iteration, gbdt.cpp:435-470; through a
         # remote tunnel each check is a ~100ms round-trip)
