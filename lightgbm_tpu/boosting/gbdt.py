@@ -162,22 +162,27 @@ class GBDT:
         # one jitted tree-build program, traced once per (shapes, params)
         growth = self.growth
         if self.mesh_ctx is None:
-            def _raw_build(dd, grad, hess, bag, fmask):
+            # once-per-dataset transposed bins for the Pallas kernels
+            from ..learner.serial import resolve_backend
+            from ..ops.pallas_histogram import transpose_bins
+            self._bins_t = None
+            if resolve_backend(self.device_data, growth.num_leaves) == "pallas":
+                self._bins_t = jax.jit(transpose_bins)(self.device_data.bins)
+            def _raw_build(dd, grad, hess, bag, fmask, bins_t=None):
                 return build_tree(dd, grad, hess, growth, bag_mask=bag,
-                                  feature_mask=fmask)
+                                  feature_mask=fmask, bins_t=bins_t)
         else:
             from ..parallel.learners import build_tree_distributed
             mesh = self.mesh_ctx.mesh
             axis = self.mesh_ctx.data_axis
             lt, tk = c.tree_learner, c.top_k
+            self._bins_t = None
 
-            def _raw_build(dd, grad, hess, bag, fmask):
+            def _raw_build(dd, grad, hess, bag, fmask, bins_t=None):
                 return build_tree_distributed(
                     mesh, axis, lt, dd, grad, hess, growth,
                     bag_mask=bag, feature_mask=fmask, top_k=tk)
-        self._raw_build = _raw_build
         self._jit_build = jax.jit(_raw_build)
-        self._batch_fns: Dict[int, object] = {}
         # how often the host checks trees for the no-more-splits stop
         # (reference checks every iteration, gbdt.cpp:435-470; through a
         # remote tunnel each check is a ~100ms round-trip)
@@ -375,7 +380,8 @@ class GBDT:
             if pad:
                 bt = bt._replace(row_leaf=bt.row_leaf[:n])
             return bt
-        return self._jit_build(self.device_data, grad, hess, bag, fmask)
+        return self._jit_build(self.device_data, grad, hess, bag, fmask,
+                               self._bins_t)
 
     def _renew_leaves(self, bt: BuiltTree, k: int) -> BuiltTree:
         """Objective-specific leaf re-fit (RenewTreeOutput,

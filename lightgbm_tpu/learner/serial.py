@@ -1,4 +1,4 @@
-"""Serial (single-shard) tree learner — one jitted wave-growth loop.
+"""Serial (single-shard) tree learner — staged wave-growth, fully jitted.
 
 TPU-native redesign of the reference ``SerialTreeLearner``
 (`/root/reference/src/treelearner/serial_tree_learner.cpp:155-622`).  The
@@ -7,8 +7,8 @@ builds the smaller child's histograms (OpenMP over feature groups), derives
 the sibling by subtraction, scans features for the best split, and
 physically repartitions row indices (`data_partition.hpp`).
 
-Here the whole tree is built by ONE ``lax.while_loop`` of *waves*, with the
-reference's histogram-economy strategy kept intact
+Here the tree is built by a sequence of *waves*, with the reference's
+histogram-economy strategy kept intact
 (`serial_tree_learner.cpp:358-372`, `feature_histogram.hpp:64-70`):
 
   1. histogram ONLY the smaller child of every split made in the previous
@@ -19,17 +19,20 @@ reference's histogram-economy strategy kept intact
      (the HistogramPool analog — no LRU needed, it all fits),
   3. re-scan ONLY those changed leaves (vectorized two-direction prefix
      scan, `ops/split.py`) and cache their best splits,
-  4. split every positive-gain leaf (up to ``wave_size``) in one go.
+  4. split every positive-gain leaf (up to the wave's slot count) in one
+     go, routing rows with one Pallas pass (`ops/pallas_route.py`).
 
-``wave_size=1`` reproduces the reference's leaf-wise growth decision-for-
-decision; the default full wave splits all splittable leaves per wave —
-~log2(num_leaves) histogram passes per tree, each touching every row once.
+The wave loop is *staged*: the first ``ceil(log2(L))`` waves are unrolled
+with active-slot counts growing 8, 8, 16, 32, ... so the histogram
+kernel's MXU cost tracks the actual number of active leaves (a tree's
+early waves are nearly free), then a ``lax.while_loop`` at a fixed slot
+count finishes any leftover splits.  ``wave_size=1`` reproduces the
+reference's leaf-wise growth decision-for-decision.
 
 Everything is static-shape: leaf arrays are sized ``[num_leaves]``, tree
-node arrays ``[num_leaves-1]``, active-split slots ``[num_leaves//2]``,
-and finished trees report a dynamic ``num_leaves`` scalar.  The same step
-runs unchanged under ``shard_map`` for the distributed learners (the
-active-leaf histograms gain a ``psum``).
+node arrays ``[num_leaves-1]``, and finished trees report a dynamic
+``num_leaves`` scalar.  The same step runs unchanged under ``shard_map``
+for the distributed learners (the active-leaf histograms gain a ``psum``).
 """
 from __future__ import annotations
 
@@ -45,6 +48,7 @@ from ..ops.pallas_histogram import (bin_stride, default_backend,
                                     hist_active_pallas, hist_active_scatter,
                                     pack_values, pallas_config_ok,
                                     transpose_bins)
+from ..ops.pallas_route import route_rows_pallas, route_rows_xla
 from ..ops.split import SplitParams, SplitResult, find_best_splits
 
 NEG_INF = -1e30
@@ -82,8 +86,7 @@ class BuiltTree(NamedTuple):
 
 
 class _WaveState(NamedTuple):
-    row_leaf: jnp.ndarray        # [n] leaf per row (all rows, incl. out-of-bag)
-    hist_leaf: jnp.ndarray       # [n] leaf per row or -1 (out-of-bag)
+    leaf2: jnp.ndarray           # [2, n_pad] (row_leaf; hist_leaf/-1 bagged)
     nl: jnp.ndarray              # scalar i32 current leaf count
     done: jnp.ndarray            # scalar bool
     leaf_sum_grad: jnp.ndarray   # [L]
@@ -101,6 +104,28 @@ class _WaveState(NamedTuple):
     tree: BuiltTree
 
 
+def _round8(x: int) -> int:
+    return -(-x // 8) * 8
+
+
+def stage_plan(L: int, tail_cap: int = 64):
+    """Active-slot counts for the unrolled waves + the while-loop tail.
+
+    Wave ``w`` can split at most ``min(leaves_w, slots)`` leaves, so slot
+    counts track the doubling leaf count; the tail loop finishes whatever
+    the unrolled waves didn't (uneven gain distributions, leaf-wise mode).
+    """
+    A_full = _round8(max(1, L // 2))
+    plan = []
+    leaves = 1
+    while leaves < L and len(plan) < 32:
+        A = min(_round8(leaves), A_full, 128)
+        plan.append(A)
+        leaves += min(A, leaves)
+    A_tail = min(A_full, tail_cap)
+    return plan, A_tail
+
+
 def _empty_best(L: int, B: int) -> SplitResult:
     z = jnp.zeros(L, jnp.float32)
     return SplitResult(
@@ -115,26 +140,22 @@ def _empty_best(L: int, B: int) -> SplitResult:
         left_output=z, right_output=z)
 
 
-def _row_go_left(data: DeviceData, best: SplitResult, row_leaf, rows_feature,
-                 rows_bin):
-    """Per-row left/right decision for the leaf's chosen split."""
-    l = row_leaf
-    f = rows_feature                                     # [n] split feature per row
-    b = rows_bin                                         # [n] bin at that feature
-    mt = data.missing_types[f]
-    is_missing = (((mt == MISSING_NAN) & (b == data.nan_bins[f]))
-                  | ((mt == MISSING_ZERO) & (b == data.default_bins[f])))
-    thr = best.threshold[l]
-    num_left = jnp.where(is_missing, best.default_left[l], b <= thr)
-    cat_left = best.cat_mask[l, jnp.minimum(b, best.cat_mask.shape[-1] - 1)]
-    return jnp.where(best.is_categorical[l], cat_left, num_left)
-
-
 # ---------------------------------------------------------------------------
 # histogram-wave strategies (the learner-type seam, tree_learner.cpp:9-33)
 # ---------------------------------------------------------------------------
+def resolve_backend(data: DeviceData, num_leaf_slots: int,
+                    backend: str = "auto", hist_mode: str = "hilo") -> str:
+    if backend == "auto":
+        backend = default_backend()
+    if backend == "pallas" and not pallas_config_ok(
+            data.max_bins, num_leaf_slots, hist_mode):
+        backend = "scatter"     # >256 bins or VMEM-infeasible config
+    return backend
+
+
 def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
-                 backend: str = "auto", hist_mode: str = "hilo"):
+                 backend: str = "auto", hist_mode: str = "hilo",
+                 bins_t: Optional[jnp.ndarray] = None):
     """Build the per-wave active-leaf histogram closure
     ``(hist_leaf, active) -> [A, F, B, 3]``.
 
@@ -143,32 +164,54 @@ def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
     cross-checked by ``tests/test_pallas_hist.py`` the way the reference
     checks GPU vs CPU histograms (`gpu_tree_learner.cpp:1020-1043`).
     """
-    if backend == "auto":
-        backend = default_backend()
-    if backend == "pallas" and not pallas_config_ok(
-            data.max_bins, num_leaf_slots, hist_mode):
-        backend = "scatter"     # >256 bins or VMEM-infeasible config
+    backend = resolve_backend(data, num_leaf_slots, backend, hist_mode)
     if backend == "pallas":
-        bins_t = transpose_bins(data.bins)
+        if bins_t is None:
+            bins_t = transpose_bins(data.bins)
         vals = pack_values(grad, hess, hist_mode)
         n_pad = bins_t.shape[1]
         n = data.bins.shape[0]
 
         def hist_fn(hist_leaf, active):
             leaf = hist_leaf
-            if n_pad != n:
-                leaf = jnp.pad(hist_leaf, (0, n_pad - n),
-                               constant_values=-1)
+            if leaf.shape[0] != n_pad:
+                leaf = jnp.pad(leaf[:n], (0, n_pad - n), constant_values=-1)
             return hist_active_pallas(
                 bins_t, vals, leaf, active,
                 num_features=data.num_features, max_bins=data.max_bins,
                 mode=hist_mode)
     else:
+        n = data.bins.shape[0]
+
         def hist_fn(hist_leaf, active):
             return hist_active_scatter(
-                data.bins, grad, hess, hist_leaf, active,
+                data.bins, grad, hess, hist_leaf[:n], active,
                 max_bins=data.max_bins, num_leaf_slots=num_leaf_slots)
     return hist_fn
+
+
+def make_route_fn(data: DeviceData, backend: str,
+                  bins_t: Optional[jnp.ndarray] = None):
+    """Per-wave split application closure ``(leaf2, best, sel, new_id)
+    -> leaf2`` (the DataPartition::Split analog)."""
+    if backend == "pallas":
+        if bins_t is None:
+            bins_t = transpose_bins(data.bins)
+
+        def route_fn(leaf2, best: SplitResult, sel, new_id):
+            return route_rows_pallas(
+                bins_t, leaf2, best.feature, best.threshold,
+                best.default_left, best.is_categorical, best.cat_mask,
+                sel, new_id, data.missing_types, data.nan_bins,
+                data.default_bins)
+    else:
+        def route_fn(leaf2, best: SplitResult, sel, new_id):
+            return route_rows_xla(
+                data.bins, leaf2, best.feature, best.threshold,
+                best.default_left, best.is_categorical, best.cat_mask,
+                sel, new_id, data.missing_types, data.nan_bins,
+                data.default_bins)
+    return route_fn
 
 
 def apply_hist_wave(hist_state, new_h, act_small, act_parent, act_sibling,
@@ -196,7 +239,8 @@ def apply_hist_wave(hist_state, new_h, act_small, act_parent, act_sibling,
 
 def make_serial_strategy(data: DeviceData, grad, hess, params: GrowthParams,
                          feature_mask, psum_fn=None, backend: str = "auto",
-                         hist_mode: str = "hilo"):
+                         hist_mode: str = "hilo",
+                         bins_t: Optional[jnp.ndarray] = None):
     """The serial (and data-parallel, via `psum_fn`) wave strategy:
     histogram the active leaves, subtract siblings, rescan changed leaves.
 
@@ -204,7 +248,7 @@ def make_serial_strategy(data: DeviceData, grad, hess, params: GrowthParams,
     reference's ReduceScatter seam (`data_parallel_tree_learner.cpp:147-162`)
     collapses to one psum of the active-leaf histograms."""
     L = params.num_leaves
-    hist_fn = make_hist_fn(data, grad, hess, L, backend, hist_mode)
+    hist_fn = make_hist_fn(data, grad, hess, L, backend, hist_mode, bins_t)
 
     def wave(hist_state, hist_leaf, act_small, act_parent, act_sibling,
              lsg, lsh, lc):
@@ -232,22 +276,32 @@ def build_tree(data: DeviceData,
                strategy=None,
                psum_fn=None,
                hist_backend: str = "auto",
-               num_hist_features: Optional[int] = None) -> BuiltTree:
+               num_hist_features: Optional[int] = None,
+               bins_t: Optional[jnp.ndarray] = None) -> BuiltTree:
     """Grow one tree.  Jittable; `psum_fn` lets the data-parallel learner
     inject a collective over active-leaf histograms; `strategy` replaces
     the whole wave procedure (feature/voting-parallel,
     `parallel/learners.py`).  `num_hist_features` overrides the width of
-    the histogram state (feature-parallel shards keep only their slice)."""
+    the histogram state (feature-parallel shards keep only their slice);
+    `bins_t` is the once-per-dataset transposed bins (computed here when
+    absent)."""
     n, F = data.bins.shape
     L = params.num_leaves
     Lm = max(L - 1, 1)
     B = bin_stride(data.max_bins)
-    A = max(1, L // 2)
     Fh = num_hist_features if num_hist_features is not None else F
 
-    row_leaf = jnp.zeros(n, jnp.int32)
-    hist_leaf = (jnp.where(bag_mask, 0, -1).astype(jnp.int32)
-                 if bag_mask is not None else jnp.zeros(n, jnp.int32))
+    backend = resolve_backend(data, L, hist_backend)
+    if backend == "pallas" and bins_t is None:
+        bins_t = transpose_bins(data.bins)
+    n_pad = bins_t.shape[1] if backend == "pallas" else n
+
+    row_leaf0 = jnp.zeros(n, jnp.int32)
+    hist_leaf0 = (jnp.where(bag_mask, 0, -1).astype(jnp.int32)
+                  if bag_mask is not None else row_leaf0)
+    leaf2 = jnp.full((2, n_pad), -1, jnp.int32)
+    leaf2 = jax.lax.dynamic_update_slice(leaf2, row_leaf0[None, :], (0, 0))
+    leaf2 = jax.lax.dynamic_update_slice(leaf2, hist_leaf0[None, :], (1, 0))
 
     tree = BuiltTree(
         feature=jnp.zeros(Lm, jnp.int32),
@@ -264,13 +318,13 @@ def build_tree(data: DeviceData,
         leaf_count=jnp.zeros(L, jnp.int32),
         leaf_depth=jnp.zeros(L, jnp.int32),
         num_leaves=jnp.asarray(1, jnp.int32),
-        row_leaf=row_leaf,
+        row_leaf=row_leaf0,
     )
 
     # root statistics (in-bag)
-    bag = (hist_leaf == 0)
-    sum_g = jnp.sum(jnp.where(bag, grad, 0.0))
-    sum_h = jnp.sum(jnp.where(bag, hess, 0.0))
+    bag = (leaf2[1] == 0)
+    sum_g = jnp.sum(jnp.where(bag[:n], grad, 0.0))
+    sum_h = jnp.sum(jnp.where(bag[:n], hess, 0.0))
     cnt = jnp.sum(bag.astype(jnp.float32))
     if psum_fn is not None:
         sum_g, sum_h, cnt = psum_fn((sum_g, sum_h, cnt))
@@ -279,9 +333,23 @@ def build_tree(data: DeviceData,
     root_out = _leaf_out(sum_g, sum_h, params.split.lambda_l1,
                          params.split.lambda_l2)
 
-    pad_a = jnp.full(A, -1, jnp.int32)
+    # staged waves only pay off on the Pallas path (MXU cost ∝ slots);
+    # the scatter backend compiles one while-loop body instead (8 unrolled
+    # stages × shard_map × 3 learners is minutes of XLA-CPU compile time)
+    if backend == "pallas":
+        plan, A_tail = stage_plan(L)
+    else:
+        plan, A_tail = [], _round8(max(1, L // 2))
+    wave_cap = params.wave_size if params.wave_size > 0 else L
+    if strategy is None:
+        strategy = make_serial_strategy(data, grad, hess, params,
+                                        feature_mask, psum_fn=psum_fn,
+                                        backend=backend, bins_t=bins_t)
+    route_fn = make_route_fn(data, backend, bins_t)
+
+    A0 = plan[0] if plan else A_tail
     state = _WaveState(
-        row_leaf=row_leaf, hist_leaf=hist_leaf,
+        leaf2=leaf2,
         nl=jnp.asarray(1, jnp.int32), done=jnp.asarray(False),
         leaf_sum_grad=jnp.zeros(L).at[0].set(sum_g),
         leaf_sum_hess=jnp.zeros(L).at[0].set(sum_h),
@@ -292,25 +360,16 @@ def build_tree(data: DeviceData,
         leaf_is_left=jnp.zeros(L, bool),
         hist_state=jnp.zeros((L, Fh, B, 3), jnp.float32),
         best=_empty_best(L, B),
-        act_small=pad_a.at[0].set(0),    # root wave: histogram leaf 0 …
-        act_parent=pad_a,                # … with no parent to subtract from
-        act_sibling=pad_a,
+        act_small=jnp.full(A0, -1, jnp.int32).at[0].set(0),  # root wave
+        act_parent=jnp.full(A0, -1, jnp.int32),
+        act_sibling=jnp.full(A0, -1, jnp.int32),
         tree=tree,
     )
 
-    wave_cap = params.wave_size if params.wave_size > 0 else L
-    if strategy is None:
-        strategy = make_serial_strategy(data, grad, hess, params,
-                                        feature_mask, psum_fn=psum_fn,
-                                        backend=hist_backend)
-
-    def cond(s: _WaveState):
-        return (~s.done) & (s.nl < L)
-
-    def body(s: _WaveState) -> _WaveState:
+    def body(s: _WaveState, A_out: int) -> _WaveState:
         # --- 1-3: histogram active leaves, subtract siblings, rescan ----
         hist_state, ids, res = strategy(
-            s.hist_state, s.hist_leaf, s.act_small, s.act_parent,
+            s.hist_state, s.leaf2[1], s.act_small, s.act_parent,
             s.act_sibling, s.leaf_sum_grad, s.leaf_sum_hess, s.leaf_count)
         best = jax.tree.map(
             lambda cur, new: cur.at[
@@ -328,7 +387,7 @@ def build_tree(data: DeviceData,
         rank = jnp.argsort(order)                       # rank[l]
         budget = L - s.nl
         k = jnp.minimum(jnp.minimum(jnp.sum(can), budget),
-                        jnp.minimum(wave_cap, A))
+                        min(wave_cap, A_out))
         sel = can & (rank < k)
 
         new_id = jnp.where(sel, s.nl + rank, L)         # L => drop scatter
@@ -383,18 +442,9 @@ def build_tree(data: DeviceData,
         lp = lp.at[new_id].set(node_idx, mode="drop")
         lil = lil.at[new_id].set(False, mode="drop")
 
-        # --- 7: route rows ----------------------------------------------
-        def route(leaf_vec):
-            safe = jnp.maximum(leaf_vec, 0)
-            f = best.feature[safe]
-            b = jnp.take_along_axis(
-                data.bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
-            go_left = _row_go_left(data, best, safe, f, b)
-            moved = sel[safe] & ~go_left & (leaf_vec >= 0)
-            return jnp.where(moved, new_id[safe], leaf_vec)
-
-        row_leaf2 = route(s.row_leaf)
-        hist_leaf2 = route(s.hist_leaf)
+        # --- 7: route rows (one kernel pass for both leaf vectors) ------
+        leaf2 = route_fn(s.leaf2, best, sel,
+                         jnp.where(sel, new_id, 0).astype(jnp.int32))
 
         # --- 8: next wave's active sets (smaller child + subtraction) ---
         # the smaller child gets histogrammed; the sibling is derived from
@@ -402,14 +452,15 @@ def build_tree(data: DeviceData,
         smaller_left = best.left_count <= best.right_count
         small_val = jnp.where(smaller_left, lid, new_id)
         sib_val = jnp.where(smaller_left, new_id, lid)
-        slot = jnp.where(sel, rank, A)
-        act_small = pad_a.at[slot].set(small_val, mode="drop")
-        act_parent = pad_a.at[slot].set(lid, mode="drop")
-        act_sibling = pad_a.at[slot].set(sib_val, mode="drop")
+        slot = jnp.where(sel, rank, A_out)
+        pad_out = jnp.full(A_out, -1, jnp.int32)
+        act_small = pad_out.at[slot].set(small_val, mode="drop")
+        act_parent = pad_out.at[slot].set(lid, mode="drop")
+        act_sibling = pad_out.at[slot].set(sib_val, mode="drop")
 
         nl2 = s.nl + k
         return _WaveState(
-            row_leaf=row_leaf2, hist_leaf=hist_leaf2, nl=nl2,
+            leaf2=leaf2, nl=nl2,
             done=(k == 0),
             leaf_sum_grad=lsg, leaf_sum_hess=lsh, leaf_count=lc,
             leaf_depth=ld, leaf_value=lv, leaf_parent=lp, leaf_is_left=lil,
@@ -418,13 +469,22 @@ def build_tree(data: DeviceData,
             act_sibling=act_sibling,
             tree=t)
 
-    final = jax.lax.while_loop(cond, body, state)
+    # --- staged unrolled waves (slot counts track the growing tree) -----
+    for i, A_in in enumerate(plan):
+        A_out = plan[i + 1] if i + 1 < len(plan) else A_tail
+        state = body(state, A_out)
+
+    # --- while-loop tail at fixed slot count -----------------------------
+    def cond(s: _WaveState):
+        return (~s.done) & (s.nl < L)
+
+    final = jax.lax.while_loop(cond, lambda s: body(s, A_tail), state)
     return final.tree._replace(
         leaf_value=final.leaf_value,
         leaf_count=final.leaf_count.astype(jnp.int32),
         leaf_depth=final.leaf_depth,
         num_leaves=final.nl,
-        row_leaf=final.row_leaf,
+        row_leaf=final.leaf2[0, :n],
     )
 
 

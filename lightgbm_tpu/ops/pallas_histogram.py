@@ -9,24 +9,31 @@ scatter-add becomes dense linear algebra:
 For one row-tile of ``T`` rows we build, entirely in VMEM,
 
 * ``oh``  ``[F*B, T]``   one-hot of each row's (feature, bin) joint index,
-* ``vw``  ``[T, C*A]``   per-row values ``(grad, hess, 1)`` replicated into
+* ``vw``  ``[T, cols]``  per-row values ``(grad, hess, 1)`` replicated into
   the column block of the row's leaf — nonzero only where the row's leaf
   is in the ``active`` list (the wave's "smaller children",
   `serial_tree_learner.cpp:358-372`),
 
-and accumulate ``oh @ vw -> [F*B, C*A]`` into a VMEM accumulator over the
+and accumulate ``oh @ vw -> [F*B, cols]`` into a VMEM accumulator over the
 row grid.  The one-hot itself is produced by a tiny MXU matmul
 (``spread.T @ bins`` replicates each feature's bin id across its B output
 rows) followed by one vector compare — no gathers, no cross-lane
 reshapes.
 
+The column count adapts to the wave: ``cols = round128(C * round8(A))``,
+so MXU work scales with the number of active leaves — the first waves of
+a tree (1, 2, 4, ... active leaves) cost a fraction of a full wave.  The
+staged wave plan in ``learner/serial.py`` exploits this by growing the
+active-slot count as the tree grows.
+
 Memory layout notes:
 
-* ``bins_t`` is the binned matrix TRANSPOSED to ``[F, n]`` bfloat16 (bin
-  ids <= 256 are exact in bf16; larger bin counts are routed to the
+* ``bins_t`` is the binned matrix TRANSPOSED to ``[F, n]`` uint8 (one
+  byte per element on the HBM stream; converted to bf16 in VMEM —
+  bin ids <= 256 are exact in bf16; larger bin counts are routed to the
   scatter backend by :func:`pallas_config_ok`).  The transpose is done
-  once per tree; the
-  kernel then streams ``[Ft, T]`` blocks with the row dimension on lanes.
+  once per dataset; the kernel then streams ``[Ft, T]`` blocks with the
+  row dimension on lanes.
 * bins are laid out at a fixed power-of-two stride ``B`` per feature, so
   the output is directly the padded ``[A, F, B, 3]`` grid the vectorized
   split scan consumes — no ragged offsets.
@@ -49,8 +56,8 @@ from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
-DEFAULT_ROW_TILE = 512
-# cap for the [Ft*B, C*A] f32 VMEM accumulator
+DEFAULT_ROW_TILE = 1024
+# cap for the [Ft*B, cols] f32 VMEM accumulator
 _ACC_VMEM_BYTES = 6 * 1024 * 1024
 
 
@@ -67,31 +74,41 @@ def bin_stride(max_bins: int) -> int:
     return max(8, _next_pow2(max_bins))
 
 
+def _col_layout(A: int, mode: str) -> tuple[int, int, int]:
+    """-> (C, A_pad, cols): value columns, padded active slots, lane-
+    aligned total output columns."""
+    C = 5 if mode == "hilo" else 3
+    A_pad = _round_up(A, 8)
+    cols = _round_up(C * A_pad, LANE)
+    return C, A_pad, cols
+
+
 def pallas_config_ok(max_bins: int, num_leaves: int, mode: str) -> bool:
     """Whether the matmul kernel can handle this config exactly.
 
     * bin ids ride through bf16, exact only up to 256 — larger bin counts
       (``Dataset`` switches to int32 bins past 256) need the scatter path;
-    * the ``[feat_tile*B, C*A_pad]`` f32 accumulator must fit the minimum
+    * the ``[feat_tile*B, cols]`` f32 accumulator must fit the minimum
       feat_tile of 8 within VMEM.
     """
     if max_bins > 256:
         return False
     B = bin_stride(max_bins)
-    C = 5 if mode == "hilo" else 3
-    A_pad = _round_up(max(max(1, num_leaves // 2), LANE), LANE)
-    return 8 * B * C * A_pad * 4 <= 12 * 1024 * 1024
+    # the staged wave plan (learner/serial.py stage_plan) caps active
+    # slots at 128 regardless of num_leaves
+    _, _, cols = _col_layout(min(max(1, num_leaves // 2), 128), mode)
+    return 8 * B * cols * 4 <= 12 * 1024 * 1024
 
 
 def transpose_bins(bins: jnp.ndarray, row_tile: int = DEFAULT_ROW_TILE,
                    feat_tile: int | None = None) -> jnp.ndarray:
-    """``[n, F] uint8 -> [F_pad, n_pad] bf16`` once-per-tree input prep."""
+    """``[n, F] uint8 -> [F_pad, n_pad] uint8`` once-per-dataset prep."""
     n, F = bins.shape
     n_pad = _round_up(n, row_tile)
     F_pad = _round_up(F, feat_tile or F)
-    out = jnp.zeros((F_pad, n_pad), jnp.bfloat16)
+    out = jnp.zeros((F_pad, n_pad), jnp.uint8)
     return jax.lax.dynamic_update_slice(
-        out, bins.T.astype(jnp.bfloat16), (0, 0))
+        out, bins.T.astype(jnp.uint8), (0, 0))
 
 
 def pack_values(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
@@ -125,7 +142,7 @@ def _spread_matrix(feat_tile: int, B: int) -> np.ndarray:
 
 
 def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref, spread_ref,
-                 out_ref, *, n_cols: int, B: int):
+                 out_ref, *, n_cols: int, B: int, pad_cols: int):
     """One (feature-tile, row-tile) grid cell; accumulates over row tiles."""
     rt = pl.program_id(1)
 
@@ -134,18 +151,20 @@ def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref, spread_ref,
         out_ref[:] = jnp.zeros_like(out_ref)
 
     # [Ft*B, T] — each feature's bin id replicated across its B rows
-    binsrep = jnp.dot(spread_ref[:], bins_ref[:],
+    binsrep = jnp.dot(spread_ref[:],
+                      bins_ref[:].astype(jnp.int32).astype(jnp.bfloat16),
                       preferred_element_type=jnp.float32)
     brow = jax.lax.broadcasted_iota(
         jnp.int32, binsrep.shape, 0) & (B - 1)
     oh = (binsrep == brow.astype(jnp.float32)).astype(jnp.bfloat16)
 
-    # [T, A] leaf membership mask over the active-leaf list
+    # [T, A_pad] leaf membership mask over the active-leaf list
     m = (leaf_ref[:] == active_ref[:]).astype(jnp.bfloat16)
     vals = vals_ref[:]                                       # [T, C] f32
-    vw = jnp.concatenate(
-        [m * vals[:, c:c + 1].astype(jnp.bfloat16) for c in range(n_cols)],
-        axis=1)                                              # [T, C*A]
+    blocks = [m * vals[:, c:c + 1].astype(jnp.bfloat16) for c in range(n_cols)]
+    if pad_cols:
+        blocks.append(jnp.zeros((m.shape[0], pad_cols), jnp.bfloat16))
+    vw = jnp.concatenate(blocks, axis=1)                     # [T, cols]
 
     out_ref[:] += jax.lax.dot_general(
         oh, vw, (((1,), (0,)), ((), ())),
@@ -169,7 +188,7 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     """Histograms for the active leaves: ``-> [A, F, B, 3]`` float32.
 
     Args:
-      bins_t: ``[F_pad, n_pad]`` bf16 transposed binned matrix
+      bins_t: ``[F_pad, n_pad]`` uint8 transposed binned matrix
         (:func:`transpose_bins`).
       vals: ``[n_pad, C]`` f32 packed value columns (:func:`pack_values`).
       row_leaf: ``[n]`` int32 leaf per row; rows whose leaf is not in
@@ -183,6 +202,9 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     Returns:
       ``[A, F, B, 3]`` f32 with B = ``bin_stride(max_bins)``, cells
       ``(sum_grad, sum_hess, count)``.
+
+    MXU cost scales with ``round128(C*round8(A))`` — small waves are
+    proportionally cheap.
     """
     F_pad, n_pad = bins_t.shape
     C = vals.shape[1]
@@ -191,11 +213,12 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     T = row_tile
     assert n_pad % T == 0, (n_pad, T)
 
-    A_pad = _round_up(max(A, LANE), LANE)
+    _, A_pad, cols = _col_layout(A, "hilo" if C == 5 else "bf16")
+    pad_cols = cols - C * A_pad
     # feature tile: bounded by the f32 accumulator's VMEM budget; when
     # tiling, the block's sublane dim must be a multiple of 8 (Mosaic
     # tiling constraint — a full-array block is exempt)
-    ft_cap = max(1, _ACC_VMEM_BYTES // (B * C * A_pad * 4))
+    ft_cap = max(1, _ACC_VMEM_BYTES // (B * cols * 4))
     if ft_cap >= F_pad:
         feat_tile = F_pad
     else:
@@ -217,7 +240,7 @@ def hist_active_pallas(bins_t: jnp.ndarray,
 
     grid = (F_grid // feat_tile, n_pad // T)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, n_cols=C, B=B),
+        functools.partial(_hist_kernel, n_cols=C, B=B, pad_cols=pad_cols),
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, A_pad), lambda f, r: (0, 0),
@@ -231,14 +254,15 @@ def hist_active_pallas(bins_t: jnp.ndarray,
             pl.BlockSpec((feat_tile * B, feat_tile), lambda f, r: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
-        out_specs=pl.BlockSpec((feat_tile * B, C * A_pad),
+        out_specs=pl.BlockSpec((feat_tile * B, cols),
                                lambda f, r: (f, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((F_grid * B, C * A_pad), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct((F_grid * B, cols), jnp.float32),
         interpret=interpret,
     )(act, bins_t, vals, leaf, spread)
 
-    # [F_grid*B, C*A_pad] -> [A, F, B, C'] -> combine hi/lo -> [A, F, B, 3]
+    # [F_grid*B, cols] -> [A, F, B, C'] -> combine hi/lo -> [A, F, B, 3]
+    out = out.reshape(F_grid, B, cols)[:, :, :C * A_pad]
     out = out.reshape(F_grid, B, C, A_pad)
     out = out.transpose(3, 0, 1, 2)[:A, :num_features]       # [A, F, B, C]
     if C == 5:

@@ -1,0 +1,221 @@
+"""Pallas TPU row-routing kernel — split application without gathers.
+
+The TPU counterpart of the reference's ``DataPartition::Split``
+(`/root/reference/src/treelearner/data_partition.hpp`, threaded index
+shuffling) combined with the per-row split decision of
+``Dataset::Split`` (`src/io/dataset.h:412-419`).  Our row→leaf vector
+design needs, per wave, for every row: look up its leaf's chosen split
+(feature, threshold, default direction, categorical mask), read the row's
+bin at that feature, and move the row to the right-child id if it goes
+right.
+
+In XLA this is a chain of ``[n]``-sized gathers from small tables plus a
+``take_along_axis`` over the ``[n, F]`` matrix — each of which lowers to
+a slow serialized gather on TPU (~3-25 ms per pass at 1M rows).  Here the
+whole decision runs in VMEM per row-tile:
+
+* leaf one-hot ``[L_pad, T]`` (compare against an iota — no gather),
+* per-leaf split tables fetched by ONE small matmul
+  ``tabs[8, L_pad] @ ohL -> [8, T]``,
+* the row's bin at its split feature by a masked sublane reduction over
+  the ``[F, T]`` bins tile (no gather),
+* per-feature missing metadata by another small matmul over the feature
+  one-hot,
+* categorical membership by ``cat_mask[B, L_pad] @ ohL`` + a bin one-hot
+  reduction.
+
+Two leaf vectors ride together (``row_leaf`` for all rows, ``hist_leaf``
+with bagged-out rows parked at -1) so both are routed in one pass.
+
+Streams ``bins_t`` (uint8) + the leaf vectors once per wave — the whole
+route costs ~1 stream pass instead of ~50 ms of gathers.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from ..io.binning import MISSING_NAN, MISSING_ZERO
+
+LANE = 128
+DEFAULT_ROW_TILE = 1024
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, fmeta_ref,
+                  out_ref, *, B: int):
+    leaf = leaf2_ref[0:1, :]                                  # [1, T] i32
+    T = leaf.shape[1]
+    L_pad = tabs_ref.shape[1]
+    F_pad = bins_ref.shape[0]
+
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
+    ohL = (iota_l == leaf).astype(jnp.float32)                # [L_pad, T]
+    sel8 = jnp.dot(tabs_ref[:], ohL,
+                   preferred_element_type=jnp.float32)        # [8, T]
+    f_row = sel8[0:1, :]
+    thr = sel8[1:2, :]
+    dl = sel8[2:3, :]
+    iscat = sel8[3:4, :]
+    selm = sel8[4:5, :]
+    new_id = sel8[5:6, :]
+
+    binsf = bins_ref[:].astype(jnp.int32).astype(jnp.float32)  # [F, T]
+    iota_f = jax.lax.broadcasted_iota(
+        jnp.int32, (F_pad, T), 0).astype(jnp.float32)
+    ohF = (iota_f == f_row).astype(jnp.float32)               # [F, T]
+    b = jnp.sum(ohF * binsf, axis=0, keepdims=True)           # [1, T]
+
+    fm = jnp.dot(fmeta_ref[:], ohF,
+                 preferred_element_type=jnp.float32)          # [4, T]
+    mt = fm[0:1, :]
+    nanb = fm[1:2, :]
+    defb = fm[2:3, :]
+
+    # all masks ride as f32 0/1 values (Mosaic rejects bool-valued selects)
+    one = jnp.ones_like(b)
+    zero = jnp.zeros_like(b)
+    is_missing = jnp.where(
+        ((mt == float(MISSING_NAN)) & (b == nanb))
+        | ((mt == float(MISSING_ZERO)) & (b == defb)), one, zero)
+
+    catrow = jnp.dot(cat_ref[:], ohL,
+                     preferred_element_type=jnp.float32)      # [B, T]
+    iota_b = jax.lax.broadcasted_iota(
+        jnp.int32, (B, T), 0).astype(jnp.float32)
+    cat_left = jnp.sum(
+        jnp.where(iota_b == b, catrow, 0.0), axis=0,
+        keepdims=True)                                        # [1, T]
+
+    le_thr = jnp.where(b <= thr, one, zero)
+    num_left = jnp.where(is_missing > 0.5, dl, le_thr)
+    go_left = jnp.where(iscat > 0.5, cat_left, num_left)
+    in_tree = jnp.where(leaf >= 0, one, zero)
+    moved = selm * (one - jnp.minimum(go_left, one)) * in_tree
+    nid = new_id.astype(jnp.int32)
+
+    rl = jnp.where(moved > 0.5, nid, leaf)                    # row_leaf'
+    hl = leaf2_ref[1:2, :]
+    out_ref[0:1, :] = rl
+    out_ref[1:2, :] = jnp.where(hl >= 0, rl, hl)              # hist_leaf'
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("row_tile", "interpret"))
+def route_rows_pallas(bins_t: jnp.ndarray,
+                      leaf2: jnp.ndarray,
+                      feature: jnp.ndarray,
+                      threshold: jnp.ndarray,
+                      default_left: jnp.ndarray,
+                      is_categorical: jnp.ndarray,
+                      cat_mask: jnp.ndarray,
+                      sel: jnp.ndarray,
+                      new_id: jnp.ndarray,
+                      missing_types: jnp.ndarray,
+                      nan_bins: jnp.ndarray,
+                      default_bins: jnp.ndarray,
+                      *,
+                      row_tile: int = DEFAULT_ROW_TILE,
+                      interpret: bool = False) -> jnp.ndarray:
+    """Apply this wave's splits to both leaf vectors: ``-> [2, n_pad]``.
+
+    Args:
+      bins_t: ``[F_pad, n_pad]`` uint8 (shared with the hist kernel).
+      leaf2: ``[2, n_pad]`` int32 — row 0 = row_leaf (all rows), row 1 =
+        hist_leaf (bagged-out rows parked at -1).  Padding rows = -1.
+      feature/threshold/default_left/is_categorical/sel/new_id: ``[L]``
+        per-leaf split decision tables (from the wave's SplitResult);
+        ``sel`` marks the leaves actually split this wave.
+      cat_mask: ``[L, B]`` bool — bins going left for categorical splits.
+      missing_types/nan_bins/default_bins: ``[F]`` per-feature metadata.
+
+    Rows whose leaf is unselected, bagged out, or padding are unchanged.
+    """
+    F_pad, n_pad = bins_t.shape
+    L = feature.shape[0]
+    B = cat_mask.shape[1]
+    T = row_tile
+    assert n_pad % T == 0
+    L_pad = _round_up(max(L, 8), LANE)
+
+    tabs = jnp.zeros((8, L_pad), jnp.float32)
+    tabs = tabs.at[0, :L].set(feature.astype(jnp.float32))
+    tabs = tabs.at[1, :L].set(threshold.astype(jnp.float32))
+    tabs = tabs.at[2, :L].set(default_left.astype(jnp.float32))
+    tabs = tabs.at[3, :L].set(is_categorical.astype(jnp.float32))
+    tabs = tabs.at[4, :L].set(sel.astype(jnp.float32))
+    tabs = tabs.at[5, :L].set(new_id.astype(jnp.float32))
+
+    cat = jnp.zeros((B, L_pad), jnp.float32)
+    cat = cat.at[:, :L].set(cat_mask.T.astype(jnp.float32))
+
+    F = missing_types.shape[0]
+    fmeta = jnp.zeros((4, F_pad), jnp.float32)
+    fmeta = fmeta.at[0, :F].set(missing_types.astype(jnp.float32))
+    fmeta = fmeta.at[1, :F].set(nan_bins.astype(jnp.float32))
+    fmeta = fmeta.at[2, :F].set(default_bins.astype(jnp.float32))
+
+    return pl.pallas_call(
+        functools.partial(_route_kernel, B=B),
+        grid=(n_pad // T,),
+        in_specs=[
+            pl.BlockSpec((F_pad, T), lambda r: (0, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, T), lambda r: (0, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((8, L_pad), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((B, L_pad), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((4, F_pad), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((2, T), lambda r: (0, r),
+                               memory_space=pltpu.VMEM),
+        out_shape=jax.ShapeDtypeStruct((2, n_pad), jnp.int32),
+        interpret=interpret,
+    )(bins_t, leaf2, tabs, cat, fmeta)
+
+
+def route_rows_xla(bins: jnp.ndarray,
+                   leaf2: jnp.ndarray,
+                   feature: jnp.ndarray,
+                   threshold: jnp.ndarray,
+                   default_left: jnp.ndarray,
+                   is_categorical: jnp.ndarray,
+                   cat_mask: jnp.ndarray,
+                   sel: jnp.ndarray,
+                   new_id: jnp.ndarray,
+                   missing_types: jnp.ndarray,
+                   nan_bins: jnp.ndarray,
+                   default_bins: jnp.ndarray) -> jnp.ndarray:
+    """Same contract from untransposed ``[n, F]`` bins (CPU backend +
+    equivalence oracle for the kernel)."""
+    n = bins.shape[0]
+    rl = leaf2[0, :n]
+    hl = leaf2[1, :n]
+    safe = jnp.maximum(rl, 0)
+    f = feature[safe]
+    b = jnp.sum(jnp.where(f[:, None] == jnp.arange(bins.shape[1])[None, :],
+                          bins.astype(jnp.int32), 0), axis=1)
+    mt = missing_types[f]
+    is_missing = (((mt == MISSING_NAN) & (b == nan_bins[f]))
+                  | ((mt == MISSING_ZERO) & (b == default_bins[f])))
+    num_left = jnp.where(is_missing, default_left[safe], b <= threshold[safe])
+    cat_left = cat_mask[safe, jnp.minimum(b, cat_mask.shape[1] - 1)]
+    go_left = jnp.where(is_categorical[safe], cat_left, num_left)
+    moved = sel[safe] & ~go_left & (rl >= 0)
+    rl2 = jnp.where(moved, new_id[safe], rl)
+    hl2 = jnp.where(hl >= 0, rl2, hl)
+    out = jnp.stack([rl2, hl2])
+    if leaf2.shape[1] != n:
+        pad = jnp.full((2, leaf2.shape[1] - n), -1, jnp.int32)
+        out = jnp.concatenate([out, pad], axis=1)
+    return out

@@ -72,3 +72,80 @@ def test_scatter_drops_inactive_and_padding():
         assert out[slot, 0, :, 2].sum() == expect
     # padding slot accumulates nothing from in-bag rows
     assert out[1].sum() == 0.0
+
+
+def test_hist_kernel_small_A_staged():
+    """Adaptive column layout: small active lists must match the scatter
+    oracle too (the staged wave plan exercises A = 8, 16, 32...)."""
+    rng = np.random.RandomState(11)
+    n, F, L, max_bins = 2000, 9, 63, 63
+    bins = rng.randint(0, max_bins, size=(n, F)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    row_leaf = rng.randint(-1, L, size=n).astype(np.int32)
+    for A in (1, 8, 24):
+        active = np.full(A, -1, np.int32)
+        k = min(A, 6)
+        active[:k] = rng.choice(L, k, replace=False)
+        out_p = hist_active_pallas(
+            transpose_bins(jnp.asarray(bins)),
+            pack_values(jnp.asarray(grad), jnp.asarray(hess), "hilo"),
+            jnp.asarray(row_leaf), jnp.asarray(active),
+            num_features=F, max_bins=max_bins, interpret=True)
+        out_s = hist_active_scatter(
+            jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+            jnp.asarray(row_leaf), jnp.asarray(active),
+            max_bins=max_bins, num_leaf_slots=L)
+        p, s = np.asarray(out_p)[:k], np.asarray(out_s)[:k]
+        np.testing.assert_array_equal(p[..., 2], s[..., 2])
+        scale = np.abs(s[..., :2]).max() + 1e-9
+        np.testing.assert_allclose(p[..., :2] / scale, s[..., :2] / scale,
+                                   atol=5e-4)
+
+
+def test_route_kernel_matches_xla():
+    """Pallas route kernel vs the XLA oracle, covering numerical splits,
+    missing-value default directions, categorical masks, unselected
+    leaves, bagged-out rows, and padding."""
+    from lightgbm_tpu.ops.pallas_route import (route_rows_pallas,
+                                               route_rows_xla)
+    from lightgbm_tpu.io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+
+    rng = np.random.RandomState(5)
+    n, F, L, B = 3000, 6, 31, 64
+    max_bins = 63
+    bins = rng.randint(0, max_bins, size=(n, F)).astype(np.uint8)
+    row_leaf = rng.randint(0, L, size=n).astype(np.int32)
+    hist_leaf = np.where(rng.rand(n) < 0.8, row_leaf, -1).astype(np.int32)
+
+    feature = rng.randint(0, F, size=L).astype(np.int32)
+    threshold = rng.randint(0, max_bins - 1, size=L).astype(np.int32)
+    default_left = rng.rand(L) < 0.5
+    is_cat = rng.rand(L) < 0.3
+    cat_mask = rng.rand(L, B) < 0.5
+    sel = rng.rand(L) < 0.6
+    new_id = rng.randint(0, L, size=L).astype(np.int32)
+    missing_types = rng.choice(
+        [MISSING_NONE, MISSING_NAN, MISSING_ZERO], size=F).astype(np.int32)
+    nan_bins = np.where(missing_types == MISSING_NAN, max_bins - 1,
+                        -1).astype(np.int32)
+    default_bins = rng.randint(0, 3, size=F).astype(np.int32)
+
+    bins_j = jnp.asarray(bins)
+    bt = transpose_bins(bins_j)
+    n_pad = bt.shape[1]
+    leaf2 = np.full((2, n_pad), -1, np.int32)
+    leaf2[0, :n] = row_leaf
+    leaf2[1, :n] = hist_leaf
+    leaf2 = jnp.asarray(leaf2)
+
+    args = (jnp.asarray(feature), jnp.asarray(threshold),
+            jnp.asarray(default_left), jnp.asarray(is_cat),
+            jnp.asarray(cat_mask), jnp.asarray(sel), jnp.asarray(new_id),
+            jnp.asarray(missing_types), jnp.asarray(nan_bins),
+            jnp.asarray(default_bins))
+    out_p = np.asarray(route_rows_pallas(bt, leaf2, *args, interpret=True))
+    out_x = np.asarray(route_rows_xla(bins_j, leaf2, *args))
+    np.testing.assert_array_equal(out_p[:, :n], out_x[:, :n])
+    # hist_leaf stays parked at -1 for bagged-out rows
+    assert (out_p[1, :n][hist_leaf < 0] == -1).all()
