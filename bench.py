@@ -43,22 +43,42 @@ def main():
               "max_bin": max_bin, "learning_rate": 0.1,
               "min_data_in_leaf": 20, "verbose": -1}
 
+    import jax
     from lightgbm_tpu.basic import Booster
     bst = Booster(params=params, train_set=ds)
-    # warmup (compile)
+    # warmup (compile): one single iteration + one fused block
     bst.update()
+    bst._gbdt.train_block(min(iters, bst._gbdt._BLOCK_CAP))
     t0 = time.time()
-    for _ in range(iters):
-        bst.update()
+    bst._gbdt.train_block(iters)
+    jax.block_until_ready(bst._gbdt.scores)
     wall = time.time() - t0
 
     row_iters_per_sec = n * iters / wall
     vs = row_iters_per_sec / REFERENCE_ROW_ITERS_PER_SEC
+
+    # accuracy gate (VERDICT r1 #6): the timed model must actually learn —
+    # train AUC on the synthetic separable signal, mirroring the
+    # reference's GPU-vs-CPU accuracy-parity gating
+    # (docs/GPU-Performance.rst:135-161).  A perf change that breaks
+    # learning fails the bench.
+    import numpy as _np
+    scores = _np.asarray(bst._gbdt.scores[:, 0])
+    order = _np.argsort(scores, kind="stable")
+    ranks = _np.empty(n); ranks[order] = _np.arange(1, n + 1)
+    npos = y.sum(); nneg = n - npos
+    auc = (ranks[y > 0.5].sum() - npos * (npos + 1) / 2) / (npos * nneg)
+    auc_ok = bool(auc >= 0.85)
+    if not auc_ok:
+        vs = 0.0    # a bench run that failed to learn scores zero
+
     print(json.dumps({
         "metric": "higgs_shape_train_row_iters_per_sec",
         "value": round(row_iters_per_sec, 1),
         "unit": "row_iters/s",
         "vs_baseline": round(vs, 4),
+        "train_auc": round(float(auc), 5),
+        "auc_ok": auc_ok,
     }))
 
 

@@ -117,10 +117,51 @@ def _tree_shap(tree, x, phi, node, unique_depth, parent_path,
 
 
 def _decide(tree, x, node):
-    nxt = tree._decision(x, node)
+    if isinstance(x, np.ndarray) and x.dtype == np.bool_:
+        # x is a precomputed per-node go-left decision vector
+        nxt = tree.left_child[node] if x[node] else tree.right_child[node]
+    else:
+        nxt = tree._decision(x, node)
     other = (tree.right_child[node] if nxt == tree.left_child[node]
              else tree.left_child[node])
     return int(nxt), int(other)
+
+
+def _decision_matrix(tree, X: np.ndarray) -> np.ndarray:
+    """Vectorized per-(row, node) go-left decisions -> bool [n, m].
+
+    Lets the exact TreeSHAP recursion run once per *distinct* decision
+    pattern instead of once per row (rows that decide identically at
+    every internal node get identical phi)."""
+    n = X.shape[0]
+    m = tree.num_leaves - 1
+    from ..models.tree import (K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK,
+                               _K_ZERO_THRESHOLD, _bitset_to_values)
+    from ..io.binning import MISSING_NAN, MISSING_ZERO
+    D = np.zeros((n, m), bool)
+    for node in range(m):
+        f = int(tree.split_feature[node])
+        fval = X[:, f]
+        dt = int(tree.decision_type[node])
+        mt = (dt >> 2) & 3
+        nan = np.isnan(fval)
+        if dt & K_CATEGORICAL_MASK:
+            ci = int(tree.threshold_bin[node])
+            members = np.asarray(_bitset_to_values(
+                tree.cat_threshold[tree.cat_boundaries[ci]:
+                                   tree.cat_boundaries[ci + 1]]))
+            ok = ~nan & (fval >= 0)
+            cats = np.where(ok, fval, -1).astype(np.int64)
+            D[:, node] = np.isin(cats, members) & ok
+            continue
+        fval0 = np.where(nan & (mt != MISSING_NAN), 0.0, fval)
+        is_missing = (((mt == MISSING_ZERO)
+                       & (np.abs(fval0) <= _K_ZERO_THRESHOLD))
+                      | ((mt == MISSING_NAN) & nan))
+        dl = bool(dt & K_DEFAULT_LEFT_MASK)
+        D[:, node] = np.where(is_missing, dl,
+                              fval0 <= float(tree.threshold[node]))
+    return D
 
 
 def _node_count(tree, node):
@@ -162,10 +203,12 @@ def predict_contrib(gbdt, X: np.ndarray, num_iteration: int = -1) -> np.ndarray:
             continue
         ev = _expected_value(t)
         out[:, k, F] += ev
-        for r in range(n):
-            phi = np.zeros(F + 1)
-            _tree_shap(t, X[r], phi, 0, 0, [], 1.0, 1.0, -1)
-            out[r, k, :F] += phi[:F]
+        D = _decision_matrix(t, X)
+        patterns, inverse = np.unique(D, axis=0, return_inverse=True)
+        phis = np.zeros((len(patterns), F + 1))
+        for p in range(len(patterns)):
+            _tree_shap(t, patterns[p], phis[p], 0, 0, [], 1.0, 1.0, -1)
+        out[:, k, :F] += phis[inverse, :F]
     if K == 1:
         return out[:, 0, :]
     return out.reshape(n, K * (F + 1))

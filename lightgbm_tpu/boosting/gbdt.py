@@ -75,7 +75,9 @@ class GBDT:
         # round-trip through a remote-device tunnel costs ~100ms, so the
         # training loop must not fetch per iteration)
         self._host_models: List[Tree] = []
-        self._pending: List[Tuple[BuiltTree, float, float]] = []
+        # pending entries: (device tree pytree, lr, bias, n_models);
+        # n_models > 1 marks a scan-stacked block with leading axis [NB(, K)]
+        self._pending: List[Tuple[BuiltTree, float, float, int]] = []
         self.iter = 0
         self.best_iteration = -1
         self.best_score: Dict[str, Dict[str, float]] = {}
@@ -183,6 +185,7 @@ class GBDT:
                     mesh, axis, lt, dd, grad, hess, growth,
                     bag_mask=bag, feature_mask=fmask, top_k=tk)
         self._jit_build = jax.jit(_raw_build)
+        self._block_fns: Dict[int, object] = {}
         # how often the host checks trees for the no-more-splits stop
         # (reference checks every iteration, gbdt.cpp:435-470; through a
         # remote tunnel each check is a ~100ms round-trip)
@@ -284,22 +287,33 @@ class GBDT:
         self._host_models = list(value)
 
     def _num_models(self) -> int:
-        return len(self._host_models) + len(self._pending)
+        return len(self._host_models) + sum(p[3] for p in self._pending)
 
     def _flush_pending(self) -> None:
         if not self._pending:
             return
         from ..utils.timetag import tag
         with tag("to_host_tree"):
-            # ONE device->host transfer for all pending trees
+            # ONE device->host transfer for all pending trees/blocks
             fetched = jax.device_get([p[0] for p in self._pending])
-            for bt_np, lr, bias in ((f, p[1], p[2])
-                                    for f, p in zip(fetched, self._pending)):
-                host = self._to_host_tree(bt_np)
-                host.shrinkage(lr)
-                if bias:
-                    host.add_bias(bias)
-                self._host_models.append(host)
+            K = max(1, self.num_tree_per_iteration)
+            for f, (_, lr, bias, count) in zip(fetched, self._pending):
+                if count == 1:
+                    parts = [f]
+                elif K == 1:
+                    NB = f.num_leaves.shape[0]
+                    parts = [jax.tree.map(lambda a, i=i: a[i], f)
+                             for i in range(NB)]
+                else:
+                    NB = f.num_leaves.shape[0]
+                    parts = [jax.tree.map(lambda a, i=i, k=k: a[i, k], f)
+                             for i in range(NB) for k in range(K)]
+                for bt_np in parts:
+                    host = self._to_host_tree(bt_np)
+                    host.shrinkage(lr)
+                    if bias:
+                        host.add_bias(bias)
+                    self._host_models.append(host)
             self._pending = []
 
     # ------------------------------------------------------------------
@@ -344,7 +358,7 @@ class GBDT:
             # drop it so pending trees don't pin O(iters x n) HBM or ship
             # dead bytes through the batched device_get
             self._pending.append((bt._replace(row_leaf=bt.row_leaf[:0]),
-                                  self.shrinkage_rate, bias))
+                                  self.shrinkage_rate, bias, 1))
         self.iter += 1
         self._stacked_cache = None
 
@@ -459,10 +473,17 @@ class GBDT:
                 t.threshold[node] = mapper.threshold_value(int(thr_bin[node]))
         return t
 
+    @staticmethod
+    def _bundle_kw(dd: DeviceData) -> Dict[str, jnp.ndarray]:
+        if not dd.is_bundled:
+            return {}
+        return {"feat_group": dd.feat_group, "feat_offset": dd.feat_offset,
+                "num_bins": dd.num_bins}
+
     def _predict_host_tree_binned(self, tree: Tree, dd: DeviceData) -> jnp.ndarray:
         st = stack_trees([tree], max_bins=dd.max_bins)
         pred = predict_binned(st, dd.bins, dd.nan_bins, dd.default_bins,
-                              dd.missing_types)
+                              dd.missing_types, **self._bundle_kw(dd))
         if dd is self.device_data and self._row_pad:
             pred = pred[:self.num_data]     # drop distributed padding rows
         return pred
@@ -509,6 +530,101 @@ class GBDT:
                 results.append((name, mname, val, hib))
         return results
 
+    # -- fused multi-iteration training blocks --------------------------
+    def _can_block(self) -> bool:
+        """Whether iterations can run as ONE jitted ``lax.scan`` block.
+
+        The remote-device tunnel charges ~ms per enqueued op; a block
+        collapses a whole window of iterations into a single dispatch
+        (gradients → tree build → score update chained on device).
+        Excluded: distributed meshes (own path), custom fobj (host
+        callback), leaf renewal (quantile-style refit), bagging/feature
+        sampling (host RNG parity), valid sets (per-tree score replay),
+        non-plain boosters (DART/GOSS/RF override the iteration)."""
+        c = self.config
+        return (self.boosting_name == "gbdt"
+                and self.mesh_ctx is None
+                and self.fobj is None
+                and self.objective is not None
+                and not self.objective.need_renew_tree_output
+                and not self._valid_device
+                and (c.bagging_freq <= 0 or c.bagging_fraction >= 1.0)
+                and c.feature_fraction >= 1.0)
+
+    def _block_fn(self, nb: int):
+        fn = self._block_fns.get(nb)
+        if fn is not None:
+            return fn
+        obj = self.objective
+        growth = self.growth
+        dd = self.device_data
+        bins_t = self._bins_t
+        K = self.num_tree_per_iteration
+
+        def block(scores, lr):
+            def body(scores, _):
+                if K == 1:
+                    g, h = obj.get_gradients(scores[:, 0])
+                    G, H = g[:, None], h[:, None]
+                else:
+                    G, H = obj.get_gradients(scores)
+                outs = []
+                for k in range(K):
+                    bt = build_tree(dd, G[:, k], H[:, k], growth,
+                                    bins_t=bins_t)
+                    lv = jnp.where(bt.num_leaves > 1, bt.leaf_value,
+                                   jnp.zeros_like(bt.leaf_value))
+                    bt = bt._replace(leaf_value=lv)
+                    scores = scores.at[:, k].add(lr * lv[bt.row_leaf])
+                    outs.append(bt._replace(row_leaf=bt.row_leaf[:0]))
+                stacked = (outs[0] if K == 1 else
+                           jax.tree.map(lambda *xs: jnp.stack(xs), *outs))
+                return scores, stacked
+            return jax.lax.scan(body, scores, None, length=nb)
+
+        fn = jax.jit(block)
+        self._block_fns[nb] = fn
+        return fn
+
+    _BLOCK_CAP = 32
+
+    def train_block(self, num_iters: int) -> bool:
+        """Run up to ``num_iters`` iterations, batching into scan blocks
+        when possible.  Returns True when training finished (no more
+        splittable leaves)."""
+        from ..utils.timetag import tag
+        done = 0
+        while done < num_iters:
+            if not self._can_block() or (
+                    self._num_models() == 0
+                    and abs(self.init_score_value) > 1e-15):
+                # bias baking / unsupported config: per-iteration path
+                if self.train_one_iter():
+                    return True
+                done += 1
+                continue
+            nb = min(num_iters - done, self._BLOCK_CAP)
+            fn = self._block_fn(nb)
+            with tag("block") as tdone:
+                self.scores, trees = fn(self.scores,
+                                        jnp.float32(self.shrinkage_rate))
+                tdone(trees.num_leaves)
+            K = self.num_tree_per_iteration
+            self._pending.append((trees, self.shrinkage_rate, 0.0, nb * K))
+            self.iter += nb
+            self._stacked_cache = None
+            done += nb
+            # stump stop: ONE tiny fetch per block (vs per iteration)
+            last_nl = np.atleast_1d(jax.device_get(trees.num_leaves[-1]))
+            if all(int(x) <= 1 for x in last_nl):
+                self.trim_trailing_stumps()
+                log_warning(
+                    "stopped training because there are no more leaves "
+                    f"that meet the split requirements (iteration "
+                    f"{self.iter + 1})")
+                return True
+        return False
+
     # ------------------------------------------------------------------
     def train(self, num_iterations: Optional[int] = None,
               callbacks: Sequence = ()) -> None:
@@ -518,12 +634,26 @@ class GBDT:
         iters = num_iterations or c.num_iterations
         best_scores: Dict[str, float] = {}
         best_iter: Dict[str, int] = {}
-        for it in range(iters):
+        want_eval = bool(self.metrics
+                         and (c.is_training_metric or self.valid_sets))
+        it = 0
+        while it < iters:
+            # window to the next eval/snapshot boundary, run as one block
+            window = iters - it
+            if c.output_freq > 0 and want_eval:
+                window = min(window, c.output_freq - (it % c.output_freq))
+            if c.snapshot_freq > 0:
+                window = min(window, c.snapshot_freq - (it % c.snapshot_freq))
             t0 = time.time()
-            stop = self.train_one_iter()
+            if window > 1 and self._can_block():
+                stop = self.train_block(window)
+                it = self.iter if stop else it + window
+            else:
+                stop = self.train_one_iter()
+                it += 1
             if stop:
                 break
-            if c.output_freq > 0 and (it + 1) % c.output_freq == 0:
+            if c.output_freq > 0 and it % c.output_freq == 0:
                 msgs = []
                 results = []
                 if c.is_training_metric:
@@ -532,7 +662,7 @@ class GBDT:
                 for name, mname, val, hib in results:
                     msgs.append(f"{name} {mname} : {val:.6f}")
                 if msgs:
-                    log_info(f"[{it + 1}]\t" + "\t".join(msgs)
+                    log_info(f"[{it}]\t" + "\t".join(msgs)
                              + f"\t({time.time() - t0:.3f}s)")
                 # early stopping on valid metrics (callback.py:142+ analog)
                 if c.early_stopping_round > 0:
@@ -549,12 +679,12 @@ class GBDT:
                             improved = True
                     if (best_iter and not improved and
                             it - max(best_iter.values()) >= c.early_stopping_round):
-                        self.best_iteration = max(best_iter.values()) + 1
-                        log_info(f"early stopping at iteration {it + 1}, "
+                        self.best_iteration = max(best_iter.values())
+                        log_info(f"early stopping at iteration {it}, "
                                  f"best iteration {self.best_iteration}")
                         break
-            if c.snapshot_freq > 0 and (it + 1) % c.snapshot_freq == 0:
-                path = f"{c.output_model}.snapshot_iter_{it + 1}"
+            if c.snapshot_freq > 0 and it % c.snapshot_freq == 0:
+                path = f"{c.output_model}.snapshot_iter_{it}"
                 self.save_model(path)
                 log_info(f"saved snapshot to {path}")
         self.trim_trailing_stumps()
@@ -565,15 +695,13 @@ class GBDT:
         can end with undetected stump trees; reference pops them,
         gbdt.cpp:462-468)."""
         K = self.num_tree_per_iteration
-        if not self._pending:
+        if not self._pending and not self._host_models:
             return
-        nls = [int(x) for x in
-               jax.device_get([p[0].num_leaves for p in self._pending])]
+        self._flush_pending()
         trimmed = 0
-        while (len(nls) >= K
-               and all(nl <= 1 for nl in nls[-K:])):
-            nls = nls[:-K]
-            self._pending = self._pending[:-K]
+        while (len(self._host_models) >= K
+               and all(t.num_leaves <= 1 for t in self._host_models[-K:])):
+            self._host_models = self._host_models[:-K]
             self.iter -= 1
             trimmed += 1
         if trimmed:
@@ -600,7 +728,8 @@ class GBDT:
         if self.train_set is None:
             # loaded model without dataset: host-tree prediction
             return self._predict_loaded(X, num_iteration)
-        valid = self.train_set.create_valid(np.asarray(X))
+        valid = self.train_set.create_valid(np.asarray(X),
+                                            prediction_mode=True)
         dd = to_device(valid)
         K = self.num_tree_per_iteration
         n = X.shape[0]
@@ -612,12 +741,54 @@ class GBDT:
         if T == 0:
             out += self.init_score_value
             return out if K > 1 else out[:, 0]
+        if self.config is not None and self.config.pred_early_stop:
+            return self._predict_raw_early_stop(dd, n, K, T)
         for k in range(K):
             idx = list(range(k, T, K))
+            # mask width +2: the sentinel miss bin must index an
+            # always-False slot (never clamp onto a real bin)
             sub = stack_trees([self.models[i] for i in idx],
-                              max_bins=dd.max_bins)
+                              max_bins=dd.max_bins + 2)
             out[:, k] += np.asarray(predict_binned(
-                sub, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types))
+                sub, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types,
+                **self._bundle_kw(dd)))
+        return out if K > 1 else out[:, 0]
+
+    def _predict_raw_early_stop(self, dd, n: int, K: int, T: int) -> np.ndarray:
+        """Prediction early stopping (reference
+        `src/boosting/prediction_early_stop.cpp:1-100`): every
+        ``pred_early_stop_freq`` rounds, rows whose margin exceeds
+        ``pred_early_stop_margin`` stop accumulating further trees.
+        Margin: binary = 2*|score| (`:60`), multiclass = top1 - top2
+        (`:38`).  Vectorized: trees run in round chunks over the
+        still-active rows."""
+        c = self.config
+        freq = max(1, c.pred_early_stop_freq)
+        margin = c.pred_early_stop_margin
+        out = np.zeros((n, K), np.float64)
+        active = np.ones(n, bool)
+        rounds = -(-(T // K) // freq)
+        bundle_kw = self._bundle_kw(dd)
+        for r in range(rounds):
+            if not active.any():
+                break
+            rows = np.nonzero(active)[0]
+            bins_sub = dd.bins[rows]
+            for k in range(K):
+                idx = [i for i in range(k, T, K)][r * freq:(r + 1) * freq]
+                if not idx:
+                    continue
+                sub = stack_trees([self.models[i] for i in idx],
+                                  max_bins=dd.max_bins + 2)
+                out[rows, k] += np.asarray(predict_binned(
+                    sub, bins_sub, dd.nan_bins, dd.default_bins,
+                    dd.missing_types, **bundle_kw))
+            if K == 1:
+                stop = 2.0 * np.abs(out[rows, 0]) > margin
+            else:
+                part = np.partition(out[rows], K - 2, axis=1)
+                stop = (part[:, K - 1] - part[:, K - 2]) > margin
+            active[rows[stop]] = False
         return out if K > 1 else out[:, 0]
 
     def _predict_loaded(self, X, num_iteration=-1):
@@ -628,9 +799,7 @@ class GBDT:
             T = min(T, num_iteration * K)
         out = np.zeros((X.shape[0], K))
         for i in range(T):
-            k = i % K
-            for r in range(X.shape[0]):
-                out[r, k] += self.models[i].predict_row(X[r])
+            out[:, i % K] += self.models[i].predict_batch(X)
         return out if K > 1 else out[:, 0]
 
     def predict(self, X: np.ndarray, raw_score: bool = False,
@@ -646,18 +815,20 @@ class GBDT:
     def predict_leaf(self, X: np.ndarray) -> np.ndarray:
         """Per-tree leaf indices (PredictLeafIndex)."""
         from ..models.tree import predict_leaf_binned
-        valid = self.train_set.create_valid(np.asarray(X)) \
-            if self.train_set is not None else None
+        valid = (self.train_set.create_valid(np.asarray(X),
+                                             prediction_mode=True)
+                 if self.train_set is not None else None)
         if valid is None:
+            Xf = np.asarray(X, np.float64)
             out = np.zeros((len(X), len(self.models)), np.int32)
             for i, t in enumerate(self.models):
-                for r in range(len(X)):
-                    out[r, i] = t.predict_leaf_row(np.asarray(X[r], np.float64))
+                out[:, i] = t.predict_leaf_batch(Xf)
             return out
         dd = to_device(valid)
-        st = stack_trees(self.models, max_bins=dd.max_bins)
+        st = stack_trees(self.models, max_bins=dd.max_bins + 2)
         return np.asarray(predict_leaf_binned(
-            st, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types))
+            st, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types,
+            **self._bundle_kw(dd)))
 
     # ------------------------------------------------------------------
     def feature_importance(self, importance_type: str = "split",

@@ -430,6 +430,17 @@ class Config:
             raise ValueError(f"unknown tree_learner {self.tree_learner!r}")
         if self.growth_mode not in ("wave", "leafwise"):
             raise ValueError(f"unknown growth_mode {self.growth_mode!r}")
+        # accepted-but-inert knobs must warn loudly, not silently no-op
+        # (reference knobs that have no TPU counterpart)
+        from .utils.log import log_warning
+        if self.use_two_round_loading:
+            log_warning("use_two_round_loading has no effect: the TPU "
+                        "loader streams once into the HBM binned matrix")
+        if self.extra.get("gpu_platform_id") is not None or \
+                self.extra.get("gpu_device_id") is not None or \
+                self.extra.get("gpu_use_dp") is not None:
+            log_warning("gpu_* parameters have no effect: device selection "
+                        "is JAX's (TPU kernels replace the OpenCL learner)")
 
     @property
     def is_parallel(self) -> bool:

@@ -304,8 +304,17 @@ class BinMapper:
         return cnt_in_bin
 
     # ------------------------------------------------------------------
-    def value_to_bin(self, values: np.ndarray) -> np.ndarray:
-        """Vectorized value→bin (reference bin.h:450-486 binary search)."""
+    def value_to_bin(self, values: np.ndarray,
+                     prediction_mode: bool = False) -> np.ndarray:
+        """Vectorized value→bin (reference bin.h:450-486 binary search).
+
+        ``prediction_mode`` affects categorical features only: unseen /
+        negative / NaN categories map to the sentinel bin ``num_bin``
+        (beyond every split mask, so they go RIGHT — the reference's
+        raw-value ``CategoricalDecision`` semantics, `tree.h:252-271`)
+        instead of the train-binning miss bin ``num_bin - 1``
+        (`bin.h:470-485`).
+        """
         values = np.asarray(values, dtype=np.float64)
         if self.bin_type == BIN_CATEGORICAL:
             ints = np.where(np.isnan(values), -1, values).astype(np.int64)
@@ -314,9 +323,8 @@ class BinMapper:
             pos = np.searchsorted(cats[sorter], ints)
             pos = np.clip(pos, 0, len(cats) - 1)
             hit = cats[sorter[pos]] == ints
-            # unseen/negative/NaN categories -> last bin (reference bin.h
-            # categorical ValueToBin returns num_bin_ - 1 on miss)
-            out = np.where(hit, sorter[pos], self.num_bin - 1).astype(np.int32)
+            miss_bin = self.num_bin if prediction_mode else self.num_bin - 1
+            out = np.where(hit, sorter[pos], miss_bin).astype(np.int32)
             return out
 
         nan_mask = np.isnan(values)

@@ -194,6 +194,78 @@ def fast_feature_bundling(bins: np.ndarray, mappers: List[BinMapper],
     return capped
 
 
+@dataclass
+class BundleInfo:
+    """EFB group layout (our own encoding, replacing the reference's
+    FeatureGroup bin-offset bookkeeping, `feature_group.h:30-75`).
+
+    A stored column holds one *group*.  Singleton groups store the
+    feature's bins unchanged (``feat_offset == -1``).  A multi-feature
+    group column encodes: 0 = every member at its default bin; else the
+    single non-default member ``f`` with bin ``b`` as
+    ``off_f + b - (1 if b > default_f else 0)`` — each member owns the
+    disjoint range ``[off_f, off_f + num_bin_f - 2]`` and the shared bin 0
+    replaces its default (bin 0 reserved for defaults, the
+    `feature_group.h:35-36` convention).  Conflicting rows (two members
+    non-default; bounded by ``max_conflict_rate``) keep the last member's
+    value, like the reference's push-order overwrite.
+    """
+    groups: List[List[int]]        # logical used-feature ids per group
+    feat_group: np.ndarray         # int32 [F] group column per feature
+    feat_offset: np.ndarray        # int32 [F] offset in group (-1: identity)
+    group_num_bins: np.ndarray     # int32 [G]
+
+    @property
+    def is_bundled(self) -> bool:
+        return bool((self.feat_offset >= 0).any())
+
+
+def build_bundle_info(groups: List[List[int]],
+                      num_bins: np.ndarray) -> BundleInfo:
+    F = int(num_bins.shape[0])
+    feat_group = np.zeros(F, np.int32)
+    feat_offset = np.full(F, -1, np.int32)
+    gnb = np.zeros(len(groups), np.int32)
+    for g, members in enumerate(groups):
+        if len(members) == 1:
+            f = members[0]
+            feat_group[f] = g
+            gnb[g] = num_bins[f]
+            continue
+        off = 1
+        for f in members:
+            feat_group[f] = g
+            feat_offset[f] = off
+            off += int(num_bins[f]) - 1
+        gnb[g] = off
+    return BundleInfo(groups=groups, feat_group=feat_group,
+                      feat_offset=feat_offset, group_num_bins=gnb)
+
+
+def pack_group_columns(cols: List[np.ndarray], info: "FeatureInfo",
+                       bundle: BundleInfo) -> np.ndarray:
+    """Encode per-feature bin columns into group columns (the EFB
+    push path, reference ``FeatureGroup::PushData``)."""
+    n = len(cols[0])
+    G = len(bundle.groups)
+    dtype = np.uint8 if bundle.group_num_bins.max() <= 256 else np.int32
+    out = np.zeros((n, G), dtype=dtype)
+    for g, members in enumerate(bundle.groups):
+        if len(members) == 1:
+            out[:, g] = cols[members[0]].astype(dtype)
+            continue
+        col = np.zeros(n, np.int32)
+        for f in members:
+            b = cols[f].astype(np.int32)
+            db = int(info.default_bins[f])
+            off = int(bundle.feat_offset[f])
+            nz = b != db
+            enc = off + b - (b > db)
+            col[nz] = enc[nz]
+        out[:, g] = col.astype(dtype)
+    return out
+
+
 # ---------------------------------------------------------------------------
 @dataclass
 class FeatureInfo:
@@ -223,9 +295,10 @@ class BinnedDataset:
     """
 
     def __init__(self) -> None:
-        self.bins: np.ndarray = np.zeros((0, 0), dtype=np.uint8)  # [n, F_used]
+        self.bins: np.ndarray = np.zeros((0, 0), dtype=np.uint8)  # [n, G]
         self.mappers: List[BinMapper] = []          # per original feature
         self.feature_info: Optional[FeatureInfo] = None
+        self.bundle: Optional[BundleInfo] = None    # EFB layout (None: 1:1)
         self.metadata = Metadata()
         self.num_total_features: int = 0
         self.used_features: List[int] = []          # original idx per used column
@@ -238,7 +311,8 @@ class BinnedDataset:
                  categorical_features: Sequence[int] = (),
                  feature_names: Optional[Sequence[str]] = None,
                  reference: Optional["BinnedDataset"] = None,
-                 metadata: Optional[Metadata] = None) -> "BinnedDataset":
+                 metadata: Optional[Metadata] = None,
+                 prediction_mode: bool = False) -> "BinnedDataset":
         """Sample→FindBin→bin all rows (reference DatasetLoader::LoadFromFile
         stages, dataset_loader.cpp:159-219 + 744-993)."""
         X = np.asarray(X)
@@ -263,10 +337,22 @@ class BinnedDataset:
             ds.used_features = reference.used_features
             ds.feature_info = reference.feature_info
             ds.feature_names = reference.feature_names
+            # prediction mode: unbundled columns + sentinel categorical
+            # miss bins (raw-value CategoricalDecision semantics)
+            ds.bundle = None if prediction_mode else reference.bundle
             cols = []
             for f in ds.used_features:
-                cols.append(ds.mappers[f].value_to_bin(X[:, f]))
-            ds.bins = cls._pack_columns(cols, ds.feature_info)
+                cols.append(ds.mappers[f].value_to_bin(
+                    X[:, f], prediction_mode=prediction_mode))
+            if ds.bundle is not None and ds.bundle.is_bundled:
+                ds.bins = pack_group_columns(cols, ds.feature_info, ds.bundle)
+            else:
+                # prediction mode's categorical miss sentinel is num_bin,
+                # which overflows uint8 when num_bin == 256
+                force_wide = (prediction_mode
+                              and ds.feature_info.max_num_bins >= 256)
+                ds.bins = cls._pack_columns(cols, ds.feature_info,
+                                            force_int32=force_wide)
             ds.metadata = metadata or Metadata()
             return ds
 
@@ -302,7 +388,32 @@ class BinnedDataset:
         cols = [mappers[f].value_to_bin(X[:, f]) for f in ds.used_features]
         ds.feature_info = cls._build_feature_info(
             [mappers[f] for f in ds.used_features])
-        ds.bins = cls._pack_columns(cols, ds.feature_info)
+        # 4. EFB: bundle sufficiently sparse features into shared columns
+        #    (reference FastFeatureBundling, dataset.cpp:138-210)
+        ds.bundle = None
+        used_mappers = [mappers[f] for f in ds.used_features]
+        # feature-parallel slices logical feature columns; bundling would
+        # interleave them, so skip EFB for that learner
+        if (config.enable_bundle and len(ds.used_features) >= 2
+                and config.tree_learner != "feature"):
+            n_sparse = sum(m.sparse_rate >= config.sparse_threshold
+                           and m.num_bin > 1 for m in used_mappers)
+            if n_sparse >= 2:
+                feat_matrix = cls._pack_columns(cols, ds.feature_info)
+                groups = fast_feature_bundling(
+                    feat_matrix, used_mappers, config.max_conflict_rate,
+                    config.data_random_seed, config.sparse_threshold,
+                    max_group_bins=256)
+                if len(groups) < len(ds.used_features):
+                    ds.bundle = build_bundle_info(
+                        groups, ds.feature_info.num_bins)
+        if ds.bundle is not None and ds.bundle.is_bundled:
+            ds.bins = pack_group_columns(cols, ds.feature_info, ds.bundle)
+            log_info(f"EFB bundled {len(ds.used_features)} features into "
+                     f"{ds.bins.shape[1]} groups")
+        else:
+            ds.bundle = None
+            ds.bins = cls._pack_columns(cols, ds.feature_info)
         ds.metadata = metadata or Metadata()
         log_info(f"constructed dataset: {n} rows, "
                  f"{len(ds.used_features)}/{num_features} used features, "
@@ -324,10 +435,12 @@ class BinnedDataset:
         )
 
     @staticmethod
-    def _pack_columns(cols: List[np.ndarray], info: FeatureInfo) -> np.ndarray:
+    def _pack_columns(cols: List[np.ndarray], info: FeatureInfo,
+                      force_int32: bool = False) -> np.ndarray:
         if not cols:
             return np.zeros((0, 0), dtype=np.uint8)
-        dtype = np.uint8 if info.max_num_bins <= 256 else np.int32
+        dtype = (np.int32 if force_int32 or info.max_num_bins > 256
+                 else np.uint8)
         out = np.empty((len(cols[0]), len(cols)), dtype=dtype)
         for j, c in enumerate(cols):
             out[:, j] = c.astype(dtype)
@@ -342,12 +455,17 @@ class BinnedDataset:
     def num_features(self) -> int:
         return self.bins.shape[1]
 
-    def create_valid(self, X: np.ndarray, metadata: Optional[Metadata] = None
-                     ) -> "BinnedDataset":
+    def create_valid(self, X: np.ndarray, metadata: Optional[Metadata] = None,
+                     prediction_mode: bool = False) -> "BinnedDataset":
         """Bin a validation matrix with THIS dataset's mappers
-        (reference Dataset::CreateValid, dataset.h:398)."""
+        (reference Dataset::CreateValid, dataset.h:398).
+
+        ``prediction_mode`` produces an unbundled matrix with sentinel
+        categorical miss bins — use for predict paths, not valid-set
+        training eval."""
         return BinnedDataset.from_raw(np.asarray(X), self.config,
-                                      reference=self, metadata=metadata)
+                                      reference=self, metadata=metadata,
+                                      prediction_mode=prediction_mode)
 
     def subset(self, used_indices: np.ndarray) -> "BinnedDataset":
         """Row subset copy (reference CopySubset dataset.h:375)."""
@@ -356,6 +474,7 @@ class BinnedDataset:
         out.bins = self.bins[used_indices]
         out.mappers = self.mappers
         out.feature_info = self.feature_info
+        out.bundle = self.bundle
         out.num_total_features = self.num_total_features
         out.used_features = self.used_features
         out.feature_names = self.feature_names
@@ -381,6 +500,8 @@ class BinnedDataset:
             "used_features": self.used_features,
             "feature_names": self.feature_names,
             "mappers": [m.to_dict() for m in self.mappers],
+            "groups": (self.bundle.groups if self.bundle is not None
+                       else None),
         }
         np.savez_compressed(
             path, header=json.dumps(meta).encode(),
@@ -403,6 +524,9 @@ class BinnedDataset:
         ds.mappers = [BinMapper.from_dict(d) for d in meta["mappers"]]
         ds.feature_info = cls._build_feature_info(
             [ds.mappers[f] for f in ds.used_features])
+        if meta.get("groups"):
+            ds.bundle = build_bundle_info(
+                [list(g) for g in meta["groups"]], ds.feature_info.num_bins)
         ds.bins = z["bins"]
         md = Metadata()
         if len(z["label"]):

@@ -2,9 +2,9 @@
 
 The TPU analog of the reference's in-memory ``Dataset`` handed to tree
 learners (`/root/reference/include/LightGBM/dataset.h:280-578`): one dense
-``[n, F]`` integer array plus flat per-feature metadata arrays, all ready
-to be sharded over a ``jax.sharding.Mesh`` data axis by the distributed
-learners.
+``[n, G]`` integer array (G = EFB group columns; G == F when nothing
+bundles) plus flat per-feature metadata arrays, all ready to be sharded
+over a ``jax.sharding.Mesh`` data axis by the distributed learners.
 """
 from __future__ import annotations
 
@@ -22,25 +22,37 @@ class DeviceData(NamedTuple):
     """Static-shape training data pytree (device arrays + static ints).
 
     Registered as a custom pytree so the static metadata (`total_bins`,
-    `max_bins`, `has_categorical`) stays Python-side across ``jax.jit``
-    boundaries (they parameterize shapes) while the arrays are traced.
+    `max_bins`, `has_categorical`, ...) stays Python-side across
+    ``jax.jit`` boundaries (they parameterize shapes) while the arrays are
+    traced.
+
+    Feature-indexed arrays describe the F *logical* features; ``bins``
+    holds the G stored group columns (EFB, `dataset.cpp:138-210` analog);
+    ``feat_group``/``feat_offset`` map logical features into group
+    columns (`io/dataset.py` BundleInfo encoding).
     """
-    bins: jnp.ndarray           # [n, F] uint8/int32
+    bins: jnp.ndarray           # [n, G] uint8/int32 group columns
     bin_offsets: jnp.ndarray    # [F] int32 offsets into flat bin space
     num_bins: jnp.ndarray       # [F] int32 (includes NaN bin)
     default_bins: jnp.ndarray   # [F] int32 (bin of value 0.0)
     missing_types: jnp.ndarray  # [F] int32
     is_categorical: jnp.ndarray  # [F] bool
     nan_bins: jnp.ndarray       # [F] int32 (num_bins-1 where NaN else -1)
+    feat_group: jnp.ndarray     # [F] int32 group column per feature
+    feat_offset: jnp.ndarray    # [F] int32 offset in group (-1: identity)
     total_bins: int             # static
-    max_bins: int               # static
+    max_bins: int               # static: max per-FEATURE bins
     has_categorical: bool = True   # static: lets the split scan drop cat work
+    max_group_bins: int = 0     # static: max per-GROUP bins (0 -> max_bins)
+    is_bundled: bool = False    # static: any multi-feature group present
 
     def tree_flatten(self):
         children = (self.bins, self.bin_offsets, self.num_bins,
                     self.default_bins, self.missing_types,
-                    self.is_categorical, self.nan_bins)
-        aux = (self.total_bins, self.max_bins, self.has_categorical)
+                    self.is_categorical, self.nan_bins,
+                    self.feat_group, self.feat_offset)
+        aux = (self.total_bins, self.max_bins, self.has_categorical,
+               self.max_group_bins, self.is_bundled)
         return children, aux
 
     @classmethod
@@ -53,7 +65,15 @@ class DeviceData(NamedTuple):
 
     @property
     def num_features(self) -> int:
+        return self.num_bins.shape[0]
+
+    @property
+    def num_groups(self) -> int:
         return self.bins.shape[1]
+
+    @property
+    def group_max_bins(self) -> int:
+        return self.max_group_bins or self.max_bins
 
 
 def to_device(ds: BinnedDataset) -> DeviceData:
@@ -61,6 +81,17 @@ def to_device(ds: BinnedDataset) -> DeviceData:
     from .binning import MISSING_NAN
     nan_bins = np.where(info.missing_types == MISSING_NAN,
                         info.num_bins - 1, -1).astype(np.int32)
+    F = len(info.num_bins)
+    if ds.bundle is not None:
+        feat_group = ds.bundle.feat_group
+        feat_offset = ds.bundle.feat_offset
+        max_group_bins = int(ds.bundle.group_num_bins.max())
+        is_bundled = bool(ds.bundle.is_bundled)
+    else:
+        feat_group = np.arange(F, dtype=np.int32)
+        feat_offset = np.full(F, -1, np.int32)
+        max_group_bins = int(info.max_num_bins)
+        is_bundled = False
     return DeviceData(
         bins=jnp.asarray(ds.bins),
         bin_offsets=jnp.asarray(info.bin_offsets[:-1], jnp.int32),
@@ -69,7 +100,11 @@ def to_device(ds: BinnedDataset) -> DeviceData:
         missing_types=jnp.asarray(info.missing_types, jnp.int32),
         is_categorical=jnp.asarray(info.is_categorical),
         nan_bins=jnp.asarray(nan_bins),
+        feat_group=jnp.asarray(feat_group, jnp.int32),
+        feat_offset=jnp.asarray(feat_offset, jnp.int32),
         total_bins=int(info.total_bins),
         max_bins=int(info.max_num_bins),
         has_categorical=bool(info.is_categorical.any()),
+        max_group_bins=max_group_bins,
+        is_bundled=is_bundled,
     )

@@ -148,7 +148,7 @@ def resolve_backend(data: DeviceData, num_leaf_slots: int,
     if backend == "auto":
         backend = default_backend()
     if backend == "pallas" and not pallas_config_ok(
-            data.max_bins, num_leaf_slots, hist_mode):
+            data.group_max_bins, num_leaf_slots, hist_mode):
         backend = "scatter"     # >256 bins or VMEM-infeasible config
     return backend
 
@@ -178,7 +178,7 @@ def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
                 leaf = jnp.pad(leaf[:n], (0, n_pad - n), constant_values=-1)
             return hist_active_pallas(
                 bins_t, vals, leaf, active,
-                num_features=data.num_features, max_bins=data.max_bins,
+                num_features=data.num_groups, max_bins=data.group_max_bins,
                 mode=hist_mode)
     else:
         n = data.bins.shape[0]
@@ -186,7 +186,8 @@ def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
         def hist_fn(hist_leaf, active):
             return hist_active_scatter(
                 data.bins, grad, hess, hist_leaf[:n], active,
-                max_bins=data.max_bins, num_leaf_slots=num_leaf_slots)
+                max_bins=data.group_max_bins,
+                num_leaf_slots=num_leaf_slots)
     return hist_fn
 
 
@@ -203,14 +204,16 @@ def make_route_fn(data: DeviceData, backend: str,
                 bins_t, leaf2, best.feature, best.threshold,
                 best.default_left, best.is_categorical, best.cat_mask,
                 sel, new_id, data.missing_types, data.nan_bins,
-                data.default_bins)
+                data.default_bins, data.feat_group, data.feat_offset,
+                data.num_bins)
     else:
         def route_fn(leaf2, best: SplitResult, sel, new_id):
             return route_rows_xla(
                 data.bins, leaf2, best.feature, best.threshold,
                 best.default_left, best.is_categorical, best.cat_mask,
                 sel, new_id, data.missing_types, data.nan_bins,
-                data.default_bins)
+                data.default_bins, data.feat_group, data.feat_offset,
+                data.num_bins)
     return route_fn
 
 
@@ -252,12 +255,18 @@ def make_serial_strategy(data: DeviceData, grad, hess, params: GrowthParams,
 
     def wave(hist_state, hist_leaf, act_small, act_parent, act_sibling,
              lsg, lsh, lc):
-        new_h = hist_fn(hist_leaf, act_small)            # [A, F, B, 3]
+        new_h = hist_fn(hist_leaf, act_small)            # [A, G, Bg, 3]
         if psum_fn is not None:
             new_h = psum_fn(new_h)
         hist_state, ids, grid = apply_hist_wave(
             hist_state, new_h, act_small, act_parent, act_sibling, L)
         safe = jnp.clip(ids, 0, L - 1)
+        if data.is_bundled:
+            from ..ops.histogram import unbundle_grid
+            grid = unbundle_grid(grid, lsg[safe], lsh[safe], lc[safe],
+                                 data.feat_group, data.feat_offset,
+                                 data.num_bins, data.default_bins,
+                                 bin_stride(data.max_bins))
         res = find_best_splits(grid, lsg[safe], lsh[safe], lc[safe],
                                data.num_bins, data.missing_types,
                                data.default_bins, data.is_categorical,
@@ -285,11 +294,13 @@ def build_tree(data: DeviceData,
     the histogram state (feature-parallel shards keep only their slice);
     `bins_t` is the once-per-dataset transposed bins (computed here when
     absent)."""
-    n, F = data.bins.shape
+    n = data.bins.shape[0]
     L = params.num_leaves
     Lm = max(L - 1, 1)
-    B = bin_stride(data.max_bins)
-    Fh = num_hist_features if num_hist_features is not None else F
+    B = bin_stride(data.max_bins)                  # feature-space stride
+    Bh = bin_stride(data.group_max_bins)           # stored-column stride
+    Gh = (num_hist_features if num_hist_features is not None
+          else data.num_groups)
 
     backend = resolve_backend(data, L, hist_backend)
     if backend == "pallas" and bins_t is None:
@@ -358,7 +369,7 @@ def build_tree(data: DeviceData,
         leaf_value=jnp.zeros(L, jnp.float32).at[0].set(root_out),
         leaf_parent=jnp.full(L, -1, jnp.int32),
         leaf_is_left=jnp.zeros(L, bool),
-        hist_state=jnp.zeros((L, Fh, B, 3), jnp.float32),
+        hist_state=jnp.zeros((L, Gh, Bh, 3), jnp.float32),
         best=_empty_best(L, B),
         act_small=jnp.full(A0, -1, jnp.int32).at[0].set(0),  # root wave
         act_parent=jnp.full(A0, -1, jnp.int32),
@@ -495,11 +506,16 @@ def predict_built_tree(tree: BuiltTree, data: DeviceData,
     n = bins.shape[0]
     node = jnp.where(tree.num_leaves > 1, 0, ~0) * jnp.ones(n, jnp.int32)
 
+    from ..ops.pallas_route import unbundle_bin
+
     def body(_, node):
         is_leaf = node < 0
         nidx = jnp.maximum(node, 0)
         f = tree.feature[nidx]
-        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        c = jnp.take_along_axis(
+            bins, data.feat_group[f][:, None], axis=1)[:, 0].astype(jnp.int32)
+        b = unbundle_bin(c, data.feat_offset[f], data.num_bins[f],
+                         data.default_bins[f])
         mt = data.missing_types[f]
         is_missing = (((mt == MISSING_NAN) & (b == data.nan_bins[f]))
                       | ((mt == MISSING_ZERO) & (b == data.default_bins[f])))

@@ -188,6 +188,67 @@ class Tree:
             if node < 0:
                 return ~node
 
+    def predict_leaf_batch(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized numpy traversal over all rows -> leaf index [n].
+
+        The loaded-model fast path (reference `gbdt_prediction.cpp` per-row
+        walk, vectorized here): per depth step, one gather per node array;
+        categorical nodes resolve their bitset membership per unique node.
+        """
+        n = X.shape[0]
+        if self.num_leaves == 1:
+            return np.zeros(n, np.int64)
+        m = self.num_leaves - 1
+        sf = np.asarray(self.split_feature[:m], np.int64)
+        thr = np.asarray(self.threshold[:m], np.float64)
+        dt = np.asarray(self.decision_type[:m], np.int64)
+        lc = np.asarray(self.left_child[:m], np.int64)
+        rc = np.asarray(self.right_child[:m], np.int64)
+        tb = np.asarray(self.threshold_bin[:m], np.int64)
+        is_cat = (dt & K_CATEGORICAL_MASK) != 0
+        mt = (dt >> 2) & 3
+        dl = (dt & K_DEFAULT_LEFT_MASK) != 0
+        cat_members = None
+        if is_cat.any():
+            cat_members = [np.asarray(_bitset_to_values(
+                self.cat_threshold[self.cat_boundaries[ci]:
+                                   self.cat_boundaries[ci + 1]]))
+                for ci in range(len(self.cat_boundaries) - 1)]
+
+        node = np.zeros(n, np.int64)
+        active = np.arange(n)
+        while active.size:
+            nd = node[active]
+            f = sf[nd]
+            fval = X[active, f].astype(np.float64)
+            nan = np.isnan(fval)
+            fval0 = np.where(nan & (mt[nd] != MISSING_NAN), 0.0, fval)
+            is_missing = (((mt[nd] == MISSING_ZERO)
+                           & (np.abs(fval0) <= _K_ZERO_THRESHOLD))
+                          | ((mt[nd] == MISSING_NAN) & nan))
+            go_left = np.where(is_missing, dl[nd], fval0 <= thr[nd])
+            ic = is_cat[nd]
+            if ic.any():
+                cat_left = np.zeros(ic.sum(), bool)
+                sub_nd = nd[ic]
+                sub_val = fval[ic]
+                ok = ~np.isnan(sub_val) & (sub_val >= 0)
+                cats = np.where(ok, sub_val, -1).astype(np.int64)
+                for u in np.unique(sub_nd):
+                    rows = sub_nd == u
+                    cat_left[rows] = np.isin(cats[rows],
+                                             cat_members[tb[u]])
+                cat_left &= ok
+                go_left = np.where(ic, False, go_left)
+                go_left[ic] = cat_left
+            node[active] = np.where(go_left, lc[nd], rc[nd])
+            active = active[node[active] >= 0]
+        return ~node
+
+    def predict_batch(self, X: np.ndarray) -> np.ndarray:
+        """Vectorized tree output per row -> float64 [n]."""
+        return np.asarray(self.leaf_value)[self.predict_leaf_batch(X)]
+
     def _decision(self, x: np.ndarray, node: int) -> int:
         f = self.split_feature[node]
         fval = x[f]
@@ -353,6 +414,19 @@ def _construct_bitset(values: Sequence[int]) -> List[int]:
     return words
 
 
+def _bitset_to_values(words: Sequence[int]) -> List[int]:
+    """Expand a LightGBM uint32 bitset into its member values."""
+    out = []
+    for wi, w in enumerate(words):
+        w = int(w)
+        base = wi * 32
+        while w:
+            b = (w & -w).bit_length() - 1
+            out.append(base + b)
+            w &= w - 1
+    return out
+
+
 def _bitset_contains(words: Sequence[int], v: int) -> bool:
     w = v // 32
     return w < len(words) and bool(words[w] & (1 << (v % 32)))
@@ -416,8 +490,10 @@ def stack_trees(trees: Sequence[Tree], max_bins: int = 1) -> StackedTrees:
 def predict_binned(stacked: StackedTrees, bins: jnp.ndarray,
                    nan_bins: jnp.ndarray, zero_bins: jnp.ndarray,
                    missing_types: jnp.ndarray,
-                   start_tree: int = 0, num_trees: Optional[int] = None
-                   ) -> jnp.ndarray:
+                   start_tree: int = 0, num_trees: Optional[int] = None,
+                   feat_group: Optional[jnp.ndarray] = None,
+                   feat_offset: Optional[jnp.ndarray] = None,
+                   num_bins: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Sum of tree outputs over binned rows — jittable, vectorized.
 
     Args:
@@ -435,7 +511,8 @@ def predict_binned(stacked: StackedTrees, bins: jnp.ndarray,
 
     def one_tree(sf, tb, lc, rc, lv, dl, ic, cm):
         leaf = _tree_leaf_indices(bins, sf, tb, lc, rc, dl, ic, cm,
-                                  nan_bins, zero_bins, missing_types, depth)
+                                  nan_bins, zero_bins, missing_types, depth,
+                                  feat_group, feat_offset, num_bins)
         return lv[leaf]
 
     per_tree = jax.vmap(one_tree)(
@@ -447,12 +524,16 @@ def predict_binned(stacked: StackedTrees, bins: jnp.ndarray,
 
 def predict_leaf_binned(stacked: StackedTrees, bins: jnp.ndarray,
                         nan_bins: jnp.ndarray, zero_bins: jnp.ndarray,
-                        missing_types: jnp.ndarray) -> jnp.ndarray:
+                        missing_types: jnp.ndarray,
+                        feat_group: Optional[jnp.ndarray] = None,
+                        feat_offset: Optional[jnp.ndarray] = None,
+                        num_bins: Optional[jnp.ndarray] = None) -> jnp.ndarray:
     """Per-tree leaf index per row (``PredictLeafIndex``) -> [n, T]."""
     def one_tree(sf, tb, lc, rc, lv, dl, ic, cm):
         return _tree_leaf_indices(bins, sf, tb, lc, rc, dl, ic, cm,
                                   nan_bins, zero_bins, missing_types,
-                                  stacked.max_depth)
+                                  stacked.max_depth,
+                                  feat_group, feat_offset, num_bins)
 
     leaves = jax.vmap(one_tree)(
         stacked.split_feature, stacked.threshold_bin, stacked.left_child,
@@ -462,7 +543,8 @@ def predict_leaf_binned(stacked: StackedTrees, bins: jnp.ndarray,
 
 
 def _tree_leaf_indices(bins, sf, tb, lc, rc, dl, ic, cm,
-                       nan_bins, zero_bins, missing_types, depth):
+                       nan_bins, zero_bins, missing_types, depth,
+                       feat_group=None, feat_offset=None, num_bins=None):
     n = bins.shape[0]
     node = jnp.zeros(n, jnp.int32)
 
@@ -470,7 +552,12 @@ def _tree_leaf_indices(bins, sf, tb, lc, rc, dl, ic, cm,
         is_leaf = node < 0
         nidx = jnp.maximum(node, 0)
         f = sf[nidx]                                    # [n]
-        b = jnp.take_along_axis(bins, f[:, None], axis=1)[:, 0].astype(jnp.int32)
+        col = f if feat_group is None else feat_group[f]
+        b = jnp.take_along_axis(
+            bins, col[:, None], axis=1)[:, 0].astype(jnp.int32)
+        if feat_offset is not None:
+            from ..ops.pallas_route import unbundle_bin
+            b = unbundle_bin(b, feat_offset[f], num_bins[f], zero_bins[f])
         mt = missing_types[f]
         is_missing = (((mt == MISSING_NAN) & (b == nan_bins[f]))
                       | ((mt == MISSING_ZERO) & (b == zero_bins[f])))

@@ -143,3 +143,51 @@ def pad_to_feature_grid(hist_flat: jnp.ndarray, bin_offsets: jnp.ndarray,
     valid = b[None, :] < num_bins[:, None]
     grid = hist_flat[..., idx, :]              # [..., F, max_bins, 3]
     return grid * valid[..., None].astype(grid.dtype)
+
+
+def unbundle_grid(grid: jnp.ndarray,
+                  leaf_sum_grad: jnp.ndarray,
+                  leaf_sum_hess: jnp.ndarray,
+                  leaf_count: jnp.ndarray,
+                  feat_group: jnp.ndarray,
+                  feat_offset: jnp.ndarray,
+                  num_bins: jnp.ndarray,
+                  default_bins: jnp.ndarray,
+                  out_stride: int) -> jnp.ndarray:
+    """Expand EFB group-column histograms into per-feature grids.
+
+    ``grid`` is ``[A, G, Bg, 3]`` over the stored group columns; returns
+    ``[A, F, B, 3]`` over logical features with ``B = out_stride``.  For a
+    bundled feature the shared default cell is reconstructed from the
+    leaf totals by subtraction — exactly the reference's ``FixHistogram``
+    (`/root/reference/src/io/dataset.cpp:754-773`), which rebuilds the
+    skipped default bin the same way.
+
+    Args:
+      grid: [A, G, Bg, 3] group histograms (grad, hess, count).
+      leaf_sum_grad/hess/count: [A] authoritative totals per grid row.
+      feat_group/feat_offset/num_bins/default_bins: [F] bundle layout
+        (`io/dataset.py` BundleInfo encoding; offset -1 = identity).
+      out_stride: per-feature bin stride of the output grid.
+    """
+    A, G, Bg, _ = grid.shape
+    B = out_stride
+    b = jnp.arange(B, dtype=jnp.int32)[None, :]             # [1, B]
+    off = feat_offset[:, None]
+    db = default_bins[:, None]
+    nb = num_bins[:, None]
+    ident = off < 0                                         # [F, 1]
+    src = jnp.where(ident, b, off + b - (b > db))           # [F, B]
+    valid = (b < nb) & (ident | (b != db))
+    src = jnp.clip(src, 0, Bg - 1)
+    idx = feat_group[:, None] * Bg + src                    # [F, B]
+    flat = grid.reshape(A, G * Bg, 3)
+    out = flat[:, idx]                                      # [A, F, B, 3]
+    out = jnp.where(valid[None, :, :, None], out, 0.0)
+    # reconstruct the folded default cell for bundled features
+    sums = jnp.sum(out, axis=2)                             # [A, F, 3]
+    totals = jnp.stack([leaf_sum_grad, leaf_sum_hess,
+                        leaf_count], axis=-1)[:, None, :]   # [A, 1, 3]
+    fix = totals - sums
+    at_default = ((b == db) & ~ident)[None, :, :, None]     # [1, F, B, 1]
+    return jnp.where(at_default, out + fix[:, :, None, :], out)

@@ -93,6 +93,10 @@ def pallas_config_ok(max_bins: int, num_leaves: int, mode: str) -> bool:
     """
     if max_bins > 256:
         return False
+    # the route kernel builds a [round128(L), T] f32 leaf one-hot in VMEM
+    # (ops/pallas_route.py); past ~1024 leaves it no longer fits
+    if num_leaves > 1024:
+        return False
     B = bin_stride(max_bins)
     # the staged wave plan (learner/serial.py stage_plan) caps active
     # slots at 128 regardless of num_leaves

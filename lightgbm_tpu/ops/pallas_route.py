@@ -10,17 +10,18 @@ bin at that feature, and move the row to the right-child id if it goes
 right.
 
 In XLA this is a chain of ``[n]``-sized gathers from small tables plus a
-``take_along_axis`` over the ``[n, F]`` matrix — each of which lowers to
+``take_along_axis`` over the ``[n, G]`` matrix — each of which lowers to
 a slow serialized gather on TPU (~3-25 ms per pass at 1M rows).  Here the
 whole decision runs in VMEM per row-tile:
 
 * leaf one-hot ``[L_pad, T]`` (compare against an iota — no gather),
-* per-leaf split tables fetched by ONE small matmul
-  ``tabs[8, L_pad] @ ohL -> [8, T]``,
-* the row's bin at its split feature by a masked sublane reduction over
-  the ``[F, T]`` bins tile (no gather),
-* per-feature missing metadata by another small matmul over the feature
-  one-hot,
+* ALL per-leaf split data — including the split feature's group column,
+  EFB offset, bin count, default bin, and missing metadata — fetched by
+  ONE small matmul ``tabs[16, L_pad] @ ohL -> [16, T]``,
+* the row's stored value at its split feature's group column by a masked
+  sublane reduction over the ``[G, T]`` bins tile (no gather), then the
+  EFB inverse mapping ``col -> feature bin`` in registers
+  (`io/dataset.py` BundleInfo encoding; identity when offset < 0),
 * categorical membership by ``cat_mask[B, L_pad] @ ohL`` + a bin one-hot
   reduction.
 
@@ -44,47 +45,57 @@ from ..io.binning import MISSING_NAN, MISSING_ZERO
 LANE = 128
 DEFAULT_ROW_TILE = 1024
 
+# tabs row layout (per-leaf split decision table)
+_T_GROUP, _T_THR, _T_DL, _T_ISCAT, _T_SEL, _T_NEWID = 0, 1, 2, 3, 4, 5
+_T_OFF, _T_NB, _T_DB, _T_MT, _T_NANB = 6, 7, 8, 9, 10
+_T_ROWS = 16
+
 
 def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, fmeta_ref,
-                  out_ref, *, B: int):
+def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int):
     leaf = leaf2_ref[0:1, :]                                  # [1, T] i32
     T = leaf.shape[1]
     L_pad = tabs_ref.shape[1]
-    F_pad = bins_ref.shape[0]
+    G_pad = bins_ref.shape[0]
 
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
     ohL = (iota_l == leaf).astype(jnp.float32)                # [L_pad, T]
-    sel8 = jnp.dot(tabs_ref[:], ohL,
-                   preferred_element_type=jnp.float32)        # [8, T]
-    f_row = sel8[0:1, :]
-    thr = sel8[1:2, :]
-    dl = sel8[2:3, :]
-    iscat = sel8[3:4, :]
-    selm = sel8[4:5, :]
-    new_id = sel8[5:6, :]
+    sel16 = jnp.dot(tabs_ref[:], ohL,
+                    preferred_element_type=jnp.float32)       # [16, T]
+    g_row = sel16[_T_GROUP:_T_GROUP + 1, :]
+    thr = sel16[_T_THR:_T_THR + 1, :]
+    dl = sel16[_T_DL:_T_DL + 1, :]
+    iscat = sel16[_T_ISCAT:_T_ISCAT + 1, :]
+    selm = sel16[_T_SEL:_T_SEL + 1, :]
+    new_id = sel16[_T_NEWID:_T_NEWID + 1, :]
+    off = sel16[_T_OFF:_T_OFF + 1, :]
+    nb = sel16[_T_NB:_T_NB + 1, :]
+    db = sel16[_T_DB:_T_DB + 1, :]
+    mt = sel16[_T_MT:_T_MT + 1, :]
+    nanb = sel16[_T_NANB:_T_NANB + 1, :]
 
-    binsf = bins_ref[:].astype(jnp.int32).astype(jnp.float32)  # [F, T]
-    iota_f = jax.lax.broadcasted_iota(
-        jnp.int32, (F_pad, T), 0).astype(jnp.float32)
-    ohF = (iota_f == f_row).astype(jnp.float32)               # [F, T]
-    b = jnp.sum(ohF * binsf, axis=0, keepdims=True)           # [1, T]
+    binsf = bins_ref[:].astype(jnp.int32).astype(jnp.float32)  # [G, T]
+    iota_g = jax.lax.broadcasted_iota(
+        jnp.int32, (G_pad, T), 0).astype(jnp.float32)
+    ohG = jnp.where(iota_g == g_row, 1.0, 0.0)                # [G, T]
+    c = jnp.sum(ohG * binsf, axis=0, keepdims=True)           # [1, T]
 
-    fm = jnp.dot(fmeta_ref[:], ohF,
-                 preferred_element_type=jnp.float32)          # [4, T]
-    mt = fm[0:1, :]
-    nanb = fm[1:2, :]
-    defb = fm[2:3, :]
+    # EFB inverse mapping: stored column value -> feature bin
+    one = jnp.ones_like(c)
+    zero = jnp.zeros_like(c)
+    rank = c - off
+    gt_db = jnp.where(rank >= db, one, zero)
+    in_range = jnp.where((rank >= 0) & (rank < nb - 1), one, zero)
+    b_bundled = jnp.where(in_range > 0.5, rank + gt_db, db)
+    b = jnp.where(off < -0.5, c, b_bundled)                   # [1, T]
 
     # all masks ride as f32 0/1 values (Mosaic rejects bool-valued selects)
-    one = jnp.ones_like(b)
-    zero = jnp.zeros_like(b)
     is_missing = jnp.where(
         ((mt == float(MISSING_NAN)) & (b == nanb))
-        | ((mt == float(MISSING_ZERO)) & (b == defb)), one, zero)
+        | ((mt == float(MISSING_ZERO)) & (b == db)), one, zero)
 
     catrow = jnp.dot(cat_ref[:], ohL,
                      preferred_element_type=jnp.float32)      # [B, T]
@@ -107,6 +118,27 @@ def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, fmeta_ref,
     out_ref[1:2, :] = jnp.where(hl >= 0, rl, hl)              # hist_leaf'
 
 
+def _leaf_tables(feature, threshold, default_left, is_categorical, sel,
+                 new_id, missing_types, nan_bins, default_bins, feat_group,
+                 feat_offset, num_bins, L_pad):
+    """Pack the [16, L_pad] per-leaf decision table (tiny [L] gathers)."""
+    L = feature.shape[0]
+    f = feature
+    tabs = jnp.zeros((_T_ROWS, L_pad), jnp.float32)
+    tabs = tabs.at[_T_GROUP, :L].set(feat_group[f].astype(jnp.float32))
+    tabs = tabs.at[_T_THR, :L].set(threshold.astype(jnp.float32))
+    tabs = tabs.at[_T_DL, :L].set(default_left.astype(jnp.float32))
+    tabs = tabs.at[_T_ISCAT, :L].set(is_categorical.astype(jnp.float32))
+    tabs = tabs.at[_T_SEL, :L].set(sel.astype(jnp.float32))
+    tabs = tabs.at[_T_NEWID, :L].set(new_id.astype(jnp.float32))
+    tabs = tabs.at[_T_OFF, :L].set(feat_offset[f].astype(jnp.float32))
+    tabs = tabs.at[_T_NB, :L].set(num_bins[f].astype(jnp.float32))
+    tabs = tabs.at[_T_DB, :L].set(default_bins[f].astype(jnp.float32))
+    tabs = tabs.at[_T_MT, :L].set(missing_types[f].astype(jnp.float32))
+    tabs = tabs.at[_T_NANB, :L].set(nan_bins[f].astype(jnp.float32))
+    return tabs
+
+
 @functools.partial(jax.jit,
                    static_argnames=("row_tile", "interpret"))
 def route_rows_pallas(bins_t: jnp.ndarray,
@@ -121,67 +153,59 @@ def route_rows_pallas(bins_t: jnp.ndarray,
                       missing_types: jnp.ndarray,
                       nan_bins: jnp.ndarray,
                       default_bins: jnp.ndarray,
+                      feat_group: jnp.ndarray,
+                      feat_offset: jnp.ndarray,
+                      num_bins: jnp.ndarray,
                       *,
                       row_tile: int = DEFAULT_ROW_TILE,
                       interpret: bool = False) -> jnp.ndarray:
     """Apply this wave's splits to both leaf vectors: ``-> [2, n_pad]``.
 
     Args:
-      bins_t: ``[F_pad, n_pad]`` uint8 (shared with the hist kernel).
+      bins_t: ``[G_pad, n_pad]`` uint8 (shared with the hist kernel).
       leaf2: ``[2, n_pad]`` int32 — row 0 = row_leaf (all rows), row 1 =
         hist_leaf (bagged-out rows parked at -1).  Padding rows = -1.
       feature/threshold/default_left/is_categorical/sel/new_id: ``[L]``
         per-leaf split decision tables (from the wave's SplitResult);
         ``sel`` marks the leaves actually split this wave.
-      cat_mask: ``[L, B]`` bool — bins going left for categorical splits.
-      missing_types/nan_bins/default_bins: ``[F]`` per-feature metadata.
+      cat_mask: ``[L, B]`` bool — FEATURE bins going left (categorical).
+      missing_types/nan_bins/default_bins/num_bins: ``[F]`` per-feature
+        metadata (feature-bin space).
+      feat_group/feat_offset: ``[F]`` EFB layout (offset -1 = identity).
 
     Rows whose leaf is unselected, bagged out, or padding are unchanged.
     """
-    F_pad, n_pad = bins_t.shape
+    G_pad, n_pad = bins_t.shape
     L = feature.shape[0]
     B = cat_mask.shape[1]
     T = row_tile
     assert n_pad % T == 0
     L_pad = _round_up(max(L, 8), LANE)
 
-    tabs = jnp.zeros((8, L_pad), jnp.float32)
-    tabs = tabs.at[0, :L].set(feature.astype(jnp.float32))
-    tabs = tabs.at[1, :L].set(threshold.astype(jnp.float32))
-    tabs = tabs.at[2, :L].set(default_left.astype(jnp.float32))
-    tabs = tabs.at[3, :L].set(is_categorical.astype(jnp.float32))
-    tabs = tabs.at[4, :L].set(sel.astype(jnp.float32))
-    tabs = tabs.at[5, :L].set(new_id.astype(jnp.float32))
-
+    tabs = _leaf_tables(feature, threshold, default_left, is_categorical,
+                        sel, new_id, missing_types, nan_bins, default_bins,
+                        feat_group, feat_offset, num_bins, L_pad)
     cat = jnp.zeros((B, L_pad), jnp.float32)
     cat = cat.at[:, :L].set(cat_mask.T.astype(jnp.float32))
-
-    F = missing_types.shape[0]
-    fmeta = jnp.zeros((4, F_pad), jnp.float32)
-    fmeta = fmeta.at[0, :F].set(missing_types.astype(jnp.float32))
-    fmeta = fmeta.at[1, :F].set(nan_bins.astype(jnp.float32))
-    fmeta = fmeta.at[2, :F].set(default_bins.astype(jnp.float32))
 
     return pl.pallas_call(
         functools.partial(_route_kernel, B=B),
         grid=(n_pad // T,),
         in_specs=[
-            pl.BlockSpec((F_pad, T), lambda r: (0, r),
+            pl.BlockSpec((G_pad, T), lambda r: (0, r),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((2, T), lambda r: (0, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((8, L_pad), lambda r: (0, 0),
+            pl.BlockSpec((_T_ROWS, L_pad), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((B, L_pad), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((4, F_pad), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((2, T), lambda r: (0, r),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((2, n_pad), jnp.int32),
         interpret=interpret,
-    )(bins_t, leaf2, tabs, cat, fmeta)
+    )(bins_t, leaf2, tabs, cat)
 
 
 def route_rows_xla(bins: jnp.ndarray,
@@ -195,16 +219,21 @@ def route_rows_xla(bins: jnp.ndarray,
                    new_id: jnp.ndarray,
                    missing_types: jnp.ndarray,
                    nan_bins: jnp.ndarray,
-                   default_bins: jnp.ndarray) -> jnp.ndarray:
-    """Same contract from untransposed ``[n, F]`` bins (CPU backend +
+                   default_bins: jnp.ndarray,
+                   feat_group: jnp.ndarray,
+                   feat_offset: jnp.ndarray,
+                   num_bins: jnp.ndarray) -> jnp.ndarray:
+    """Same contract from untransposed ``[n, G]`` bins (CPU backend +
     equivalence oracle for the kernel)."""
     n = bins.shape[0]
     rl = leaf2[0, :n]
     hl = leaf2[1, :n]
     safe = jnp.maximum(rl, 0)
     f = feature[safe]
-    b = jnp.sum(jnp.where(f[:, None] == jnp.arange(bins.shape[1])[None, :],
+    g = feat_group[f]
+    c = jnp.sum(jnp.where(g[:, None] == jnp.arange(bins.shape[1])[None, :],
                           bins.astype(jnp.int32), 0), axis=1)
+    b = unbundle_bin(c, feat_offset[f], num_bins[f], default_bins[f])
     mt = missing_types[f]
     is_missing = (((mt == MISSING_NAN) & (b == nan_bins[f]))
                   | ((mt == MISSING_ZERO) & (b == default_bins[f])))
@@ -219,3 +248,13 @@ def route_rows_xla(bins: jnp.ndarray,
         pad = jnp.full((2, leaf2.shape[1] - n), -1, jnp.int32)
         out = jnp.concatenate([out, pad], axis=1)
     return out
+
+
+def unbundle_bin(col: jnp.ndarray, off: jnp.ndarray, nb: jnp.ndarray,
+                 db: jnp.ndarray) -> jnp.ndarray:
+    """EFB inverse mapping: stored column value -> feature bin
+    (`io/dataset.py` BundleInfo encoding; identity when ``off < 0``)."""
+    rank = col - off
+    in_range = (rank >= 0) & (rank < nb - 1)
+    b_bundled = jnp.where(in_range, rank + (rank >= db), db)
+    return jnp.where(off < 0, col, b_bundled)

@@ -77,6 +77,10 @@ def make_feature_parallel_strategy(data: DeviceData, grad, hess,
                                    hist_backend: str = "auto"):
     """Features statically sliced per shard; per-shard histogram state
     covers only the local columns; global best via all_gather + argmax."""
+    if data.is_bundled:
+        raise ValueError(
+            "feature-parallel training does not support EFB-bundled "
+            "datasets; construct with enable_bundle=False")
     F = data.num_features
     f_local = -(-F // num_shards)          # ceil
     L = params.num_leaves
@@ -91,7 +95,9 @@ def make_feature_parallel_strategy(data: DeviceData, grad, hess,
     nanb_loc = jax.lax.dynamic_slice_in_dim(data.nan_bins, start, f_local)
     off_loc = jnp.zeros(f_local, jnp.int32)   # unused by the padded grid
     data_loc = DeviceData(bins_loc, off_loc, nb_loc, db_loc, mt_loc, ic_loc,
-                          nanb_loc, data.total_bins, data.max_bins,
+                          nanb_loc, jnp.arange(f_local, dtype=jnp.int32),
+                          jnp.full(f_local, -1, jnp.int32),
+                          data.total_bins, data.max_bins,
                           data.has_categorical)
     hist_fn = make_hist_fn(data_loc, grad, hess, L, hist_backend)
 
@@ -145,11 +151,18 @@ def make_voting_parallel_strategy(data: DeviceData, grad, hess,
         hist_state, ids, grid = apply_hist_wave(
             hist_state, new_h, act_small, act_parent, act_sibling, L)
         safe = jnp.clip(ids, 0, L - 1)
-        # local leaf totals from the local histogram (feature 0's bins
+        # local leaf totals from the local histogram (column 0's bins
         # contain every in-bag local row exactly once)
         loc_sum_g = jnp.sum(grid[:, 0, :, 0], axis=-1)
         loc_sum_h = jnp.sum(grid[:, 0, :, 1], axis=-1)
         loc_cnt = jnp.sum(grid[:, 0, :, 2], axis=-1)
+        if data.is_bundled:
+            from ..ops.histogram import unbundle_grid
+            from ..ops.pallas_histogram import bin_stride
+            grid = unbundle_grid(grid, loc_sum_g, loc_sum_h, loc_cnt,
+                                 data.feat_group, data.feat_offset,
+                                 data.num_bins, data.default_bins,
+                                 bin_stride(data.max_bins))
         local_gain = _per_feature_gains(grid, loc_sum_g, loc_sum_h, loc_cnt,
                                         data, local_params, feature_mask)
         # top-k features per changed leaf locally, weighted-gain votes
@@ -245,13 +258,15 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
     if feature_mask is None:
         feature_mask = jnp.ones(data.num_features, bool)
 
-    # static fields (total_bins/max_bins/has_categorical) are closed over;
-    # only arrays cross the shard_map boundary
-    statics = (data.total_bins, data.max_bins, data.has_categorical)
+    # static fields (total_bins/max_bins/...) are closed over; only arrays
+    # cross the shard_map boundary
+    statics = (data.total_bins, data.max_bins, data.has_categorical,
+               data.max_group_bins, data.is_bundled)
 
-    def step(bins, offs, nb, db, mt, ic, nanb, grad_l, hess_l, bag_l,
-             fmask_l):
-        data_l = DeviceData(bins, offs, nb, db, mt, ic, nanb, *statics)
+    def step(bins, offs, nb, db, mt, ic, nanb, fg, fo, grad_l, hess_l,
+             bag_l, fmask_l):
+        data_l = DeviceData(bins, offs, nb, db, mt, ic, nanb, fg, fo,
+                            *statics)
         nhf = None
         if learner_type == "data":
             strategy = None        # serial strategy + histogram psum
@@ -279,10 +294,12 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
         internal_value=P(), internal_count=P(), leaf_value=P(),
         leaf_count=P(), leaf_depth=P(), num_leaves=P(), row_leaf=vec)
 
-    in_specs = (vec, P(), P(), P(), P(), P(), P(), vec, vec, vec, P())
+    in_specs = (vec, P(), P(), P(), P(), P(), P(), P(), P(),
+                vec, vec, vec, P())
 
     fn = jax.shard_map(step, mesh=mesh, in_specs=in_specs,
                        out_specs=out_spec, check_vma=False)
     return fn(data.bins, data.bin_offsets, data.num_bins, data.default_bins,
               data.missing_types, data.is_categorical, data.nan_bins,
+              data.feat_group, data.feat_offset,
               grad, hess, bag_mask, feature_mask)

@@ -1,0 +1,212 @@
+"""EFB (Exclusive Feature Bundling) — ingest wiring + training equivalence.
+
+Reference: ``FastFeatureBundling`` (`/root/reference/src/io/dataset.cpp:138-210`),
+``FindGroups`` (`:66-136`), FeatureGroup bin-offset packing
+(`include/LightGBM/feature_group.h:30-75`).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+
+
+def _sparse_data(n=3000, n_dense=3, n_sparse=12, seed=0):
+    """Mostly-zero sparse block with disjoint support + a dense block.
+
+    Each sparse feature gets a distinct weight so split gains are well
+    separated (bundled and unbundled histograms sum f32 values in
+    different orders; exchangeable features would tie and flip splits on
+    last-ulp differences).
+    """
+    rng = np.random.RandomState(seed)
+    dense = rng.normal(size=(n, n_dense))
+    sparse = np.zeros((n, n_sparse))
+    # disjoint supports: feature j is nonzero on its own row stripe only,
+    # so bundling is conflict-free and therefore lossless
+    stripe = n // n_sparse
+    for j in range(n_sparse):
+        lo, hi = j * stripe, (j + 1) * stripe
+        nz = rng.rand(hi - lo) < 0.5
+        sparse[lo:hi, j] = np.where(nz, rng.normal(size=hi - lo), 0.0)
+    w = 1.0 + 0.37 * np.arange(n_sparse)
+    X = np.concatenate([dense, sparse], axis=1)
+    y = (dense[:, 0] + sparse @ w + 0.1 * rng.normal(size=n) > 0)
+    return X.astype(np.float64), y.astype(np.float32)
+
+
+def test_bundling_reduces_columns():
+    X, y = _sparse_data()
+    cfg = Config.from_params({"max_bin": 63})
+    ds = BinnedDataset.from_raw(X, cfg)
+    assert ds.bundle is not None and ds.bundle.is_bundled
+    F = len(ds.used_features)
+    G = ds.bins.shape[1]
+    assert G < F, (G, F)
+    assert ds.bundle.group_num_bins.max() <= 256
+    # every feature maps into exactly one group, ranges disjoint
+    for g, members in enumerate(ds.bundle.groups):
+        if len(members) < 2:
+            continue
+        lo = [int(ds.bundle.feat_offset[f]) for f in members]
+        nb = [int(ds.feature_info.num_bins[f]) for f in members]
+        spans = sorted(zip(lo, nb))
+        end = 1
+        for off, b in spans:
+            assert off == end, (off, end)
+            end = off + b - 1
+        assert end == int(ds.bundle.group_num_bins[g])
+
+
+def test_bundled_training_matches_unbundled():
+    """Conflict-free bundles are lossless up to f32 summation order: the
+    learned models must agree to metric parity (the reference's own
+    equivalence bar for alternate histogram paths,
+    `docs/GPU-Performance.rst:135-161`)."""
+    X, y = _sparse_data()
+    params = {"objective": "binary", "num_leaves": 15, "num_iterations": 8,
+              "max_bin": 63, "min_data_in_leaf": 5, "verbose": -1}
+    ds_b = lgb.Dataset(X, label=y, params={"max_bin": 63})
+    bst_b = lgb.train(params, ds_b)
+    ds_u = lgb.Dataset(X, label=y,
+                       params={"max_bin": 63, "enable_bundle": False})
+    bst_u = lgb.train({**params, "enable_bundle": False}, ds_u)
+    assert ds_b.construct()._constructed.bundle is not None
+    assert ds_u.construct()._constructed.bundle is None
+    p_b = np.clip(bst_b.predict(X), 1e-7, 1 - 1e-7)
+    p_u = np.clip(bst_u.predict(X), 1e-7, 1 - 1e-7)
+    # same first split (gains are well separated at the root)
+    t_b, t_u = bst_b._gbdt.models[0], bst_u._gbdt.models[0]
+    assert int(t_b.split_feature[0]) == int(t_u.split_feature[0])
+    assert abs(float(t_b.threshold[0]) - float(t_u.threshold[0])) < 1e-9
+    # metric parity + near-identical predictions
+    ll_b = -np.mean(y * np.log(p_b) + (1 - y) * np.log(1 - p_b))
+    ll_u = -np.mean(y * np.log(p_u) + (1 - y) * np.log(1 - p_u))
+    assert abs(ll_b - ll_u) < 0.01 * max(ll_b, ll_u), (ll_b, ll_u)
+    # near-tie splits may flip a leaf's rows, so gate the bulk, not the max
+    diff = np.abs(p_b - p_u)
+    assert np.percentile(diff, 90) < 0.02, np.percentile(diff, 90)
+    assert np.mean(diff) < 0.01, np.mean(diff)
+
+
+def test_bundled_valid_set_and_leaf_predict():
+    X, y = _sparse_data(seed=3)
+    Xv, yv = _sparse_data(seed=4)
+    params = {"objective": "binary", "metric": "binary_logloss",
+              "num_leaves": 7, "num_iterations": 5, "max_bin": 63,
+              "verbose": -1}
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+    dv = lgb.Dataset(Xv, label=yv, reference=ds, params={"max_bin": 63})
+    evals = {}
+    bst = lgb.train(params, ds, valid_sets=[dv], valid_names=["v"],
+                    callbacks=[lgb.record_evaluation(evals)])
+    assert np.isfinite(evals["v"]["binary_logloss"]).all()
+    leaves = bst.predict(X, pred_leaf=True)
+    assert leaves.shape[1] == bst.num_trees()
+
+
+def test_unbundle_grid_matches_feature_scatter():
+    """unbundle_grid output == per-feature scatter histograms."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.io.device import to_device
+    from lightgbm_tpu.ops.histogram import unbundle_grid
+    from lightgbm_tpu.ops.pallas_histogram import (bin_stride,
+                                                   hist_active_scatter)
+
+    X, y = _sparse_data(n=1200)
+    cfg = Config.from_params({"max_bin": 63})
+    ds_b = BinnedDataset.from_raw(X, cfg)
+    cfg_u = Config.from_params({"max_bin": 63, "enable_bundle": False})
+    ds_u = BinnedDataset.from_raw(X, cfg_u)
+    dd_b = to_device(ds_b)
+    dd_u = to_device(ds_u)
+
+    rng = np.random.RandomState(1)
+    n = X.shape[0]
+    grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    hess = jnp.asarray(rng.uniform(0.5, 1.0, size=n).astype(np.float32))
+    L = 4
+    row_leaf = jnp.asarray(rng.randint(0, L, size=n).astype(np.int32))
+    active = jnp.arange(L, dtype=jnp.int32)
+
+    grid_g = hist_active_scatter(dd_b.bins, grad, hess, row_leaf, active,
+                                 max_bins=dd_b.group_max_bins,
+                                 num_leaf_slots=L)
+    tot = np.zeros((L, 3), np.float32)
+    for l in range(L):
+        m = np.asarray(row_leaf) == l
+        tot[l] = [np.asarray(grad)[m].sum(), np.asarray(hess)[m].sum(),
+                  m.sum()]
+    out = unbundle_grid(grid_g, jnp.asarray(tot[:, 0]), jnp.asarray(tot[:, 1]),
+                        jnp.asarray(tot[:, 2]), dd_b.feat_group,
+                        dd_b.feat_offset, dd_b.num_bins, dd_b.default_bins,
+                        bin_stride(dd_b.max_bins))
+    ref = hist_active_scatter(dd_u.bins, grad, hess, row_leaf, active,
+                              max_bins=dd_u.max_bins, num_leaf_slots=L)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-4, atol=1e-2)
+
+
+def test_feature_parallel_rejects_bundled():
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import Mesh
+    from lightgbm_tpu.io.device import to_device
+    from lightgbm_tpu.learner.serial import GrowthParams
+    from lightgbm_tpu.parallel.learners import build_tree_distributed
+
+    X, y = _sparse_data(n=800)
+    cfg = Config.from_params({"max_bin": 63})
+    ds = BinnedDataset.from_raw(X, cfg)
+    dd = to_device(ds)
+    devs = np.array(jax.devices()[:8])
+    mesh = Mesh(devs, ("d",))
+    n = X.shape[0]
+    with pytest.raises(ValueError, match="enable_bundle"):
+        build_tree_distributed(
+            mesh, "d", "feature", dd,
+            jnp.zeros(n), jnp.ones(n), GrowthParams(num_leaves=7),
+            hist_backend="scatter")
+
+
+def test_route_kernel_bundled_matches_xla():
+    """Pallas route kernel EFB inverse mapping vs the XLA oracle."""
+    import jax.numpy as jnp
+    from lightgbm_tpu.io.device import to_device
+    from lightgbm_tpu.ops.pallas_histogram import transpose_bins
+    from lightgbm_tpu.ops.pallas_route import (route_rows_pallas,
+                                               route_rows_xla)
+
+    X, y = _sparse_data(n=2000, seed=7)
+    cfg = Config.from_params({"max_bin": 63})
+    ds = BinnedDataset.from_raw(X, cfg)
+    assert ds.bundle is not None
+    dd = to_device(ds)
+    F = dd.num_features
+    n = X.shape[0]
+    rng = np.random.RandomState(2)
+    L = 15
+    B = 64
+    row_leaf = rng.randint(0, L, size=n).astype(np.int32)
+    hist_leaf = np.where(rng.rand(n) < 0.8, row_leaf, -1).astype(np.int32)
+
+    args = (jnp.asarray(rng.randint(0, F, size=L).astype(np.int32)),
+            jnp.asarray(rng.randint(0, 10, size=L).astype(np.int32)),
+            jnp.asarray(rng.rand(L) < 0.5),
+            jnp.zeros(L, bool),
+            jnp.asarray(rng.rand(L, B) < 0.5),
+            jnp.asarray(rng.rand(L) < 0.6),
+            jnp.asarray(rng.randint(0, L, size=L).astype(np.int32)),
+            dd.missing_types, dd.nan_bins, dd.default_bins,
+            dd.feat_group, dd.feat_offset, dd.num_bins)
+
+    bt = transpose_bins(dd.bins)
+    n_pad = bt.shape[1]
+    leaf2 = np.full((2, n_pad), -1, np.int32)
+    leaf2[0, :n] = row_leaf
+    leaf2[1, :n] = hist_leaf
+    leaf2 = jnp.asarray(leaf2)
+    out_p = np.asarray(route_rows_pallas(bt, leaf2, *args, interpret=True))
+    out_x = np.asarray(route_rows_xla(dd.bins, leaf2, *args))
+    np.testing.assert_array_equal(out_p[:, :n], out_x[:, :n])

@@ -286,3 +286,33 @@ def test_weights_change_fit():
     bst2 = lgb.train({"objective": "binary", "num_leaves": 7},
                      lgb.Dataset(X, label=y), 8, verbose_eval=False)
     assert p_w > bst2.predict(X).mean()     # positive-class upweighting
+
+
+def test_pred_early_stop():
+    """Prediction early stopping (prediction_early_stop.cpp semantics):
+    approximate, but converged rows keep their sign/class."""
+    import numpy as np
+    import lightgbm_tpu as lgb
+    rng = np.random.RandomState(0)
+    n = 2000
+    X = rng.normal(size=(n, 5))
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15,
+                     "num_iterations": 30, "verbose": -1},
+                    lgb.Dataset(X, label=y))
+    full = bst.predict(X, raw_score=True)
+    g = bst._gbdt
+    g.config.pred_early_stop = True
+    g.config.pred_early_stop_freq = 5
+    g.config.pred_early_stop_margin = 2.0
+    es = bst.predict(X, raw_score=True)
+    g.config.pred_early_stop = False
+    # rows that stopped early keep a margin above the threshold and almost
+    # always agree in sign (it is an approximation, like the reference's);
+    # tolerance covers f32 chunked-summation noise for unstopped rows
+    exact = np.abs(es - full) < 1e-4
+    stopped = ~exact
+    assert stopped.any()                      # early stop actually engaged
+    assert (2.0 * np.abs(es[stopped]) > 2.0 - 1e-3).all()
+    agree = np.sign(es[stopped]) == np.sign(full[stopped])
+    assert agree.mean() > 0.99, agree.mean()

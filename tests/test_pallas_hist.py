@@ -143,7 +143,10 @@ def test_route_kernel_matches_xla():
             jnp.asarray(default_left), jnp.asarray(is_cat),
             jnp.asarray(cat_mask), jnp.asarray(sel), jnp.asarray(new_id),
             jnp.asarray(missing_types), jnp.asarray(nan_bins),
-            jnp.asarray(default_bins))
+            jnp.asarray(default_bins),
+            jnp.arange(F, dtype=jnp.int32),          # identity groups
+            jnp.full(F, -1, jnp.int32),
+            jnp.full(F, max_bins, jnp.int32))
     out_p = np.asarray(route_rows_pallas(bt, leaf2, *args, interpret=True))
     out_x = np.asarray(route_rows_xla(bins_j, leaf2, *args))
     np.testing.assert_array_equal(out_p[:, :n], out_x[:, :n])
