@@ -46,9 +46,10 @@ def main():
     import jax
     from lightgbm_tpu.basic import Booster
     bst = Booster(params=params, train_set=ds)
-    # warmup (compile): one single iteration + one fused block
+    # warmup (compile): one single iteration + a full dry pass so every
+    # power-of-two block length in the decomposition is compiled
     bst.update()
-    bst._gbdt.train_block(min(iters, bst._gbdt._BLOCK_CAP))
+    bst._gbdt.train_block(iters)
     t0 = time.time()
     bst._gbdt.train_block(iters)
     jax.block_until_ready(bst._gbdt.scores)

@@ -50,6 +50,26 @@ def split_params_from_config(c: Config) -> SplitParams:
         max_cat_to_onehot=c.max_cat_to_onehot)
 
 
+import functools
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("num_leaves", "max_depth", "wave_size",
+                                    "hist_mode"))
+def _shared_serial_build(dd, grad, hess, bag, fmask, bins_t, split,
+                         *, num_leaves, max_depth, wave_size, hist_mode):
+    """Module-level jitted serial tree build: shared across all GBDT
+    instances, with SplitParams TRACED (only the shape-determining
+    num_leaves/max_depth/wave_size are static) — so boosters differing
+    only in regularization / min-data knobs reuse one compiled program
+    instead of recompiling (the dominant cost of the CPU test suite)."""
+    growth = GrowthParams(num_leaves=num_leaves, max_depth=max_depth,
+                          wave_size=wave_size, split=split)
+    return build_tree(dd, grad, hess, growth, bag_mask=bag,
+                      feature_mask=fmask, bins_t=bins_t,
+                      hist_mode=hist_mode)
+
+
 def growth_params_from_config(c: Config) -> GrowthParams:
     return GrowthParams(
         num_leaves=c.num_leaves, max_depth=c.max_depth,
@@ -171,8 +191,12 @@ class GBDT:
             if resolve_backend(self.device_data, growth.num_leaves) == "pallas":
                 self._bins_t = jax.jit(transpose_bins)(self.device_data.bins)
             def _raw_build(dd, grad, hess, bag, fmask, bins_t=None):
-                return build_tree(dd, grad, hess, growth, bag_mask=bag,
-                                  feature_mask=fmask, bins_t=bins_t)
+                from ..learner.serial import default_hist_mode
+                return _shared_serial_build(
+                    dd, grad, hess, bag, fmask, bins_t, growth.split,
+                    num_leaves=growth.num_leaves, max_depth=growth.max_depth,
+                    wave_size=growth.wave_size,
+                    hist_mode=default_hist_mode())
         else:
             from ..parallel.learners import build_tree_distributed
             mesh = self.mesh_ctx.mesh
@@ -184,7 +208,10 @@ class GBDT:
                 return build_tree_distributed(
                     mesh, axis, lt, dd, grad, hess, growth,
                     bag_mask=bag, feature_mask=fmask, top_k=tk)
-        self._jit_build = jax.jit(_raw_build)
+        # serial path: already jitted at module level (shared cache);
+        # mesh path: per-instance jit (mesh/axis closed over)
+        self._jit_build = (_raw_build if self.mesh_ctx is None
+                           else jax.jit(_raw_build))
         self._block_fns: Dict[int, object] = {}
         # how often the host checks trees for the no-more-splits stop
         # (reference checks every iteration, gbdt.cpp:435-470; through a
@@ -298,7 +325,8 @@ class GBDT:
             fetched = jax.device_get([p[0] for p in self._pending])
             K = max(1, self.num_tree_per_iteration)
             for f, (_, lr, bias, count) in zip(fetched, self._pending):
-                if count == 1:
+                # blocks carry a leading scan axis even at length 1
+                if np.ndim(f.num_leaves) == 0:
                     parts = [f]
                 elif K == 1:
                     NB = f.num_leaves.shape[0]
@@ -481,7 +509,9 @@ class GBDT:
                 "num_bins": dd.num_bins}
 
     def _predict_host_tree_binned(self, tree: Tree, dd: DeviceData) -> jnp.ndarray:
-        st = stack_trees([tree], max_bins=dd.max_bins)
+        st = stack_trees([tree], max_bins=dd.max_bins,
+                         pad_leaves=self.growth.num_leaves
+                         if self.train_set is not None else 0)
         pred = predict_binned(st, dd.bins, dd.nan_bins, dd.default_bins,
                               dd.missing_types, **self._bundle_kw(dd))
         if dd is self.device_data and self._row_pad:
@@ -603,7 +633,12 @@ class GBDT:
                     return True
                 done += 1
                 continue
+            # power-of-two block lengths: any residue reuses one of at
+            # most log2(cap) compiled programs instead of compiling a
+            # fresh scan length mid-run
             nb = min(num_iters - done, self._BLOCK_CAP)
+            while nb & (nb - 1):
+                nb &= nb - 1
             fn = self._block_fn(nb)
             with tag("block") as tdone:
                 self.scores, trees = fn(self.scores,
@@ -773,16 +808,23 @@ class GBDT:
             if not active.any():
                 break
             rows = np.nonzero(active)[0]
-            bins_sub = dd.bins[rows]
+            # pad the active set to a power-of-two bucket: the jitted
+            # tree walk compiles per row-count, and shrinking every
+            # round would otherwise compile every round
+            bucket = 1 << (len(rows) - 1).bit_length()
+            rows_pad = np.resize(rows, bucket)
+            bins_sub = dd.bins[rows_pad]
             for k in range(K):
                 idx = [i for i in range(k, T, K)][r * freq:(r + 1) * freq]
                 if not idx:
                     continue
                 sub = stack_trees([self.models[i] for i in idx],
-                                  max_bins=dd.max_bins + 2)
+                                  max_bins=dd.max_bins + 2,
+                                  pad_leaves=self.growth.num_leaves
+                                  if self.train_set is not None else 0)
                 out[rows, k] += np.asarray(predict_binned(
                     sub, bins_sub, dd.nan_bins, dd.default_bins,
-                    dd.missing_types, **bundle_kw))
+                    dd.missing_types, **bundle_kw))[:len(rows)]
             if K == 1:
                 stop = 2.0 * np.abs(out[rows, 0]) > margin
             else:

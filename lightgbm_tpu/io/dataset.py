@@ -312,7 +312,8 @@ class BinnedDataset:
                  feature_names: Optional[Sequence[str]] = None,
                  reference: Optional["BinnedDataset"] = None,
                  metadata: Optional[Metadata] = None,
-                 prediction_mode: bool = False) -> "BinnedDataset":
+                 prediction_mode: bool = False,
+                 mappers: Optional[List[BinMapper]] = None) -> "BinnedDataset":
         """Sample→FindBin→bin all rows (reference DatasetLoader::LoadFromFile
         stages, dataset_loader.cpp:159-219 + 744-993)."""
         X = np.asarray(X)
@@ -356,13 +357,28 @@ class BinnedDataset:
             ds.metadata = metadata or Metadata()
             return ds
 
-        # 1. sample for bin finding
+        # 1-2. sample + find bins per feature (skipped when precomputed
+        # mappers are supplied — the distributed bin-finding path,
+        # io/distributed.py)
+        if mappers is not None:
+            if len(mappers) != num_features:
+                raise ValueError(
+                    f"got {len(mappers)} mappers for {num_features} features")
+            ds.mappers = mappers
+            ds.used_features = [f for f in range(num_features)
+                                if not mappers[f].is_trivial]
+            # EFB must be OFF here: bundling is driven by rank-LOCAL
+            # conflict rates, so ranks would build different group
+            # layouts despite sharing mappers — and data-parallel
+            # histogram collectives would then sum mismatched columns
+            return cls._finish_from_mappers(ds, X, config, metadata, n,
+                                            num_features,
+                                            allow_bundle=False)
         sample_cnt = min(n, config.bin_construct_sample_cnt)
         rng = np.random.RandomState(config.data_random_seed)
         sample_idx = (np.arange(n) if sample_cnt >= n
                       else np.sort(rng.choice(n, sample_cnt, replace=False)))
-        # 2. find bins per feature
-        mappers: List[BinMapper] = []
+        mappers = []
         for f in range(num_features):
             m = BinMapper()
             col = X[sample_idx, f].astype(np.float64)
@@ -382,6 +398,18 @@ class BinnedDataset:
             mappers.append(m)
         ds.mappers = mappers
         ds.used_features = [f for f in range(num_features) if not mappers[f].is_trivial]
+        return cls._finish_from_mappers(ds, X, config, metadata, n,
+                                        num_features)
+
+    @classmethod
+    def _finish_from_mappers(cls, ds: "BinnedDataset", X: np.ndarray,
+                             config: Config, metadata: Optional[Metadata],
+                             n: int, num_features: int,
+                             allow_bundle: bool = True) -> "BinnedDataset":
+        """Steps 3-4 of construction: bin all rows through ``ds.mappers``,
+        apply EFB, pack columns (shared by the local and distributed
+        bin-finding paths)."""
+        mappers = ds.mappers
         if not ds.used_features:
             log_warning("all features are trivial (constant); nothing to train on")
         # 3. bin every row (vectorized per column)
@@ -394,7 +422,8 @@ class BinnedDataset:
         used_mappers = [mappers[f] for f in ds.used_features]
         # feature-parallel slices logical feature columns; bundling would
         # interleave them, so skip EFB for that learner
-        if (config.enable_bundle and len(ds.used_features) >= 2
+        if (allow_bundle and config.enable_bundle
+                and len(ds.used_features) >= 2
                 and config.tree_learner != "feature"):
             n_sparse = sum(m.sparse_rate >= config.sparse_threshold
                            and m.num_bin > 1 for m in used_mappers)

@@ -90,13 +90,21 @@ def parse_file(path: str, config: Config
     weight_inline = None
     query_inline = None
     if fmt == "libsvm":
-        X, label = _parse_libsvm(path, skip)
+        from .. import native
+        got = native.parse_libsvm(path, skip)
+        if got is not None:
+            X, label = got
+        else:
+            X, label = _parse_libsvm(path, skip)
         feature_names = [f"Column_{i}" for i in range(X.shape[1])]
         cat_cols: List[int] = []
     else:
         sep = "," if fmt == "csv" else "\t"
-        raw = np.genfromtxt(path, delimiter=sep, skip_header=skip,
-                            dtype=np.float64)
+        from .. import native
+        raw = native.parse_delimited(path, sep, skip)
+        if raw is None:
+            raw = np.genfromtxt(path, delimiter=sep, skip_header=skip,
+                                dtype=np.float64)
         if raw.ndim == 1:
             raw = raw.reshape(-1, 1)
         ncol = raw.shape[1]
@@ -166,10 +174,16 @@ def _load_side_file(path: str, dtype=np.float32) -> Optional[np.ndarray]:
 
 def load_file(path: str, config: Config,
               reference: Optional[BinnedDataset] = None,
-              rank: int = 0, num_machines: int = 1) -> BinnedDataset:
+              rank: int = 0, num_machines: int = 1,
+              allgather=None) -> BinnedDataset:
     """Full file->BinnedDataset pipeline (reference
     DatasetLoader::LoadFromFile, dataset_loader.cpp:159-219), incl. the
-    binary-cache fast path (SaveBinaryFile/CheckCanLoadFromBin)."""
+    binary-cache fast path (SaveBinaryFile/CheckCanLoadFromBin).
+
+    With ``num_machines > 1`` and an ``allgather`` collective, bin
+    finding runs distributed: feature-sharded quantiles over the local
+    row shard, mappers allgathered so every rank bins identically
+    (`dataset_loader.cpp:816-880`; see ``io/distributed.py``)."""
     bin_path = path + ".bin.npz"
     if (config.enable_load_from_binary_file and reference is None
             and os.path.exists(bin_path)
@@ -214,8 +228,14 @@ def load_file(path: str, config: Config,
         ds = BinnedDataset.from_raw(X, config, reference=reference,
                                     metadata=md)
         return ds
+    mappers = None
+    if num_machines > 1 and allgather is not None:
+        from .distributed import find_bins_distributed
+        mappers = find_bins_distributed(X, config, rank, num_machines,
+                                        allgather, cat_cols)
     ds = BinnedDataset.from_raw(X, config, categorical_features=cat_cols,
-                                feature_names=feature_names, metadata=md)
+                                feature_names=feature_names, metadata=md,
+                                mappers=mappers)
     if config.is_save_binary_file:
         ds.save_binary(bin_path[:-4])
         log_info(f"saved binary cache {bin_path}")

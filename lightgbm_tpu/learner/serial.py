@@ -108,22 +108,27 @@ def _round8(x: int) -> int:
     return -(-x // 8) * 8
 
 
-def stage_plan(L: int, tail_cap: int = 64):
+def stage_plan(L: int, wave_size: int = 0):
     """Active-slot counts for the unrolled waves + the while-loop tail.
 
     Wave ``w`` can split at most ``min(leaves_w, slots)`` leaves, so slot
     counts track the doubling leaf count; the tail loop finishes whatever
-    the unrolled waves didn't (uneven gain distributions, leaf-wise mode).
+    the unrolled waves didn't (uneven gain distributions).  The tail runs
+    at full width so a balanced tree completes within the unrolled stages
+    (a narrow tail forced extra waves on the hot path).  Leaf-wise mode
+    (``wave_size=1``) splits one leaf per wave, so everything runs in a
+    narrow while loop instead.
     """
-    A_full = _round8(max(1, L // 2))
+    if wave_size == 1:
+        return [], 8
+    A_full = min(_round8(max(1, L // 2)), 128)
     plan = []
     leaves = 1
     while leaves < L and len(plan) < 32:
-        A = min(_round8(leaves), A_full, 128)
+        A = min(_round8(leaves), A_full)
         plan.append(A)
         leaves += min(A, leaves)
-    A_tail = min(A_full, tail_cap)
-    return plan, A_tail
+    return plan, A_full
 
 
 def _empty_best(L: int, B: int) -> SplitResult:
@@ -153,8 +158,19 @@ def resolve_backend(data: DeviceData, num_leaf_slots: int,
     return backend
 
 
+def default_hist_mode() -> str:
+    """bf16 by default: ~2^-8 relative histogram error (counts stay
+    exact; the MXU accumulates in f32) for 3/5 the MXU work — the
+    reference's own GPU posture, which defaults to single precision
+    (`docs/GPU-Performance.rst:135-161`, ``gpu_use_dp=false``).  Bench
+    AUC is identical to the hi+lo mode at 20 and 60 iterations;
+    LGBM_TPU_HIST_MODE=hilo restores ~f32 sums via hi+lo bf16 pairs."""
+    import os
+    return os.environ.get("LGBM_TPU_HIST_MODE", "bf16")
+
+
 def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
-                 backend: str = "auto", hist_mode: str = "hilo",
+                 backend: str = "auto", hist_mode: Optional[str] = None,
                  bins_t: Optional[jnp.ndarray] = None):
     """Build the per-wave active-leaf histogram closure
     ``(hist_leaf, active) -> [A, F, B, 3]``.
@@ -164,6 +180,8 @@ def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
     cross-checked by ``tests/test_pallas_hist.py`` the way the reference
     checks GPU vs CPU histograms (`gpu_tree_learner.cpp:1020-1043`).
     """
+    if hist_mode is None:
+        hist_mode = default_hist_mode()
     backend = resolve_backend(data, num_leaf_slots, backend, hist_mode)
     if backend == "pallas":
         if bins_t is None:
@@ -242,7 +260,7 @@ def apply_hist_wave(hist_state, new_h, act_small, act_parent, act_sibling,
 
 def make_serial_strategy(data: DeviceData, grad, hess, params: GrowthParams,
                          feature_mask, psum_fn=None, backend: str = "auto",
-                         hist_mode: str = "hilo",
+                         hist_mode: Optional[str] = None,
                          bins_t: Optional[jnp.ndarray] = None):
     """The serial (and data-parallel, via `psum_fn`) wave strategy:
     histogram the active leaves, subtract siblings, rescan changed leaves.
@@ -286,7 +304,8 @@ def build_tree(data: DeviceData,
                psum_fn=None,
                hist_backend: str = "auto",
                num_hist_features: Optional[int] = None,
-               bins_t: Optional[jnp.ndarray] = None) -> BuiltTree:
+               bins_t: Optional[jnp.ndarray] = None,
+               hist_mode: Optional[str] = None) -> BuiltTree:
     """Grow one tree.  Jittable; `psum_fn` lets the data-parallel learner
     inject a collective over active-leaf histograms; `strategy` replaces
     the whole wave procedure (feature/voting-parallel,
@@ -348,14 +367,15 @@ def build_tree(data: DeviceData,
     # the scatter backend compiles one while-loop body instead (8 unrolled
     # stages × shard_map × 3 learners is minutes of XLA-CPU compile time)
     if backend == "pallas":
-        plan, A_tail = stage_plan(L)
+        plan, A_tail = stage_plan(L, params.wave_size)
     else:
         plan, A_tail = [], _round8(max(1, L // 2))
     wave_cap = params.wave_size if params.wave_size > 0 else L
     if strategy is None:
         strategy = make_serial_strategy(data, grad, hess, params,
                                         feature_mask, psum_fn=psum_fn,
-                                        backend=backend, bins_t=bins_t)
+                                        backend=backend, bins_t=bins_t,
+                                        hist_mode=hist_mode)
     route_fn = make_route_fn(data, backend, bins_t)
 
     A0 = plan[0] if plan else A_tail
@@ -499,6 +519,7 @@ def build_tree(data: DeviceData,
     )
 
 
+@jax.jit
 def predict_built_tree(tree: BuiltTree, data: DeviceData,
                        bins: jnp.ndarray) -> jnp.ndarray:
     """Leaf value per row of `bins` for a just-built tree (validation score

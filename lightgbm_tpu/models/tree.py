@@ -20,6 +20,7 @@ gather-based prediction on TPU.
 """
 from __future__ import annotations
 
+import functools
 import math
 from typing import List, NamedTuple, Optional, Sequence
 
@@ -435,8 +436,20 @@ def _bitset_contains(words: Sequence[int], v: int) -> bool:
 # ---------------------------------------------------------------------------
 # Device-side stacked model for jit prediction
 # ---------------------------------------------------------------------------
+@jax.tree_util.register_pytree_node_class
 class StackedTrees(NamedTuple):
-    """All trees of a model packed into ``[T, ...]`` arrays (device pytree)."""
+    """All trees of a model packed into ``[T, ...]`` arrays (device pytree).
+
+    ``max_depth`` is static aux data (it bounds the jitted walk loop),
+    so the prediction programs cache across calls."""
+
+    def tree_flatten(self):
+        return (tuple(self[:-1]), self.max_depth)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux)
+
     split_feature: jnp.ndarray    # [T, M] inner feature idx
     threshold_bin: jnp.ndarray    # [T, M]
     left_child: jnp.ndarray       # [T, M]
@@ -448,10 +461,16 @@ class StackedTrees(NamedTuple):
     max_depth: int                # static
 
 
-def stack_trees(trees: Sequence[Tree], max_bins: int = 1) -> StackedTrees:
-    """Pack host trees into padded device arrays for vectorized prediction."""
+def stack_trees(trees: Sequence[Tree], max_bins: int = 1,
+                pad_leaves: int = 0) -> StackedTrees:
+    """Pack host trees into padded device arrays for vectorized prediction.
+
+    ``pad_leaves`` pads the leaf axis to a caller-stable size so repeated
+    single-tree predictions (DART drop sets, rollback, valid replay)
+    reuse one compiled program instead of recompiling per tree shape.
+    """
     T = len(trees)
-    L = max(max(t.num_leaves for t in trees), 2) if T else 2
+    L = max(max(t.num_leaves for t in trees), 2, pad_leaves) if T else 2
     M = L - 1
     any_cat = any(t.num_cat > 0 for t in trees)
     B = max_bins if any_cat else 1
@@ -481,12 +500,16 @@ def stack_trees(trees: Sequence[Tree], max_bins: int = 1) -> StackedTrees:
             if ic[i, node]:
                 bins = t.cat_left_bins[t.threshold_bin[node]]
                 cm[i, node, bins[bins < B]] = True
-    depth = max((t.max_depth for t in trees), default=1)
+    depth = max(max((t.max_depth for t in trees), default=1), 1)
+    # round the walk depth to a power of two: the fori_loop length is a
+    # static program parameter, so raw depths recompile per tree
+    depth = 1 << (depth - 1).bit_length()
     return StackedTrees(jnp.asarray(sf), jnp.asarray(tb), jnp.asarray(lc),
                         jnp.asarray(rc), jnp.asarray(lv), jnp.asarray(dl),
-                        jnp.asarray(ic), jnp.asarray(cm), max(depth, 1))
+                        jnp.asarray(ic), jnp.asarray(cm), depth)
 
 
+@functools.partial(jax.jit, static_argnames=("start_tree", "num_trees"))
 def predict_binned(stacked: StackedTrees, bins: jnp.ndarray,
                    nan_bins: jnp.ndarray, zero_bins: jnp.ndarray,
                    missing_types: jnp.ndarray,
@@ -522,6 +545,7 @@ def predict_binned(stacked: StackedTrees, bins: jnp.ndarray,
     return jnp.sum(per_tree, axis=0)
 
 
+@jax.jit
 def predict_leaf_binned(stacked: StackedTrees, bins: jnp.ndarray,
                         nan_bins: jnp.ndarray, zero_bins: jnp.ndarray,
                         missing_types: jnp.ndarray,

@@ -1,0 +1,108 @@
+"""Native runtime components (C++ via ctypes — no pybind11).
+
+The reference ships its ingest hot loops in C++
+(`/root/reference/src/io/parser.cpp`, `utils/text_reader.h`); this
+package keeps that contract: ``parser.cpp`` compiles lazily on first use
+(g++, cached next to the source) and binds through the CPython-free
+C ABI.  Everything degrades gracefully to the pure-Python paths when no
+toolchain is available or ``LGBM_TPU_NO_NATIVE=1``.
+"""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional, Tuple
+
+import numpy as np
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "parser.cpp")
+_LIB = os.path.join(_DIR, "_ltpu_parser.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_tried = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _tried
+    if _tried:
+        return _lib
+    _tried = True
+    if os.environ.get("LGBM_TPU_NO_NATIVE"):
+        return None
+    try:
+        if (not os.path.exists(_LIB)
+                or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)):
+            # build to a private temp file + atomic rename: concurrent
+            # processes (distributed ingest workers, pytest-xdist) must
+            # never dlopen a partially written .so
+            tmp = f"{_LIB}.{os.getpid()}.tmp"
+            subprocess.check_call(
+                ["g++", "-O3", "-shared", "-fPIC", "-o", tmp, _SRC],
+                stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+            os.replace(tmp, _LIB)
+        lib = ctypes.CDLL(_LIB)
+        lib.ltpu_parse_delimited.restype = ctypes.c_long
+        lib.ltpu_parse_delimited.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_long,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_long)]
+        lib.ltpu_parse_libsvm.restype = ctypes.c_long
+        lib.ltpu_parse_libsvm.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double))]
+        lib.ltpu_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
+        _lib = lib
+    except Exception:
+        _lib = None
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def _take(lib, ptr, shape) -> np.ndarray:
+    """Copy a malloc'd native buffer into numpy and free it."""
+    n = int(np.prod(shape)) if shape else 0
+    arr = np.ctypeslib.as_array(ptr, shape=(max(n, 1),))[:n].copy()
+    lib.ltpu_free(ptr)
+    return arr.reshape(shape)
+
+
+def parse_delimited(path: str, delim: str, skip: int) -> Optional[np.ndarray]:
+    """[rows, cols] float64 (missing fields NaN) or None if unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    data = ctypes.POINTER(ctypes.c_double)()
+    cols = ctypes.c_long()
+    rows = lib.ltpu_parse_delimited(
+        path.encode(), delim.encode(), skip, ctypes.byref(data),
+        ctypes.byref(cols))
+    if rows < 0:
+        return None
+    if rows == 0 or cols.value == 0:
+        return np.zeros((0, max(cols.value, 0)), np.float64)
+    return _take(lib, data, (int(rows), int(cols.value)))
+
+
+def parse_libsvm(path: str, skip: int
+                 ) -> Optional[Tuple[np.ndarray, np.ndarray]]:
+    """(X [rows, max_idx+1] f64, labels [rows] f32) or None."""
+    lib = _load()
+    if lib is None:
+        return None
+    X = ctypes.POINTER(ctypes.c_double)()
+    y = ctypes.POINTER(ctypes.c_double)()
+    cols = ctypes.c_long()
+    rows = lib.ltpu_parse_libsvm(path.encode(), skip, ctypes.byref(X),
+                                 ctypes.byref(cols), ctypes.byref(y))
+    if rows < 0:
+        return None
+    Xa = _take(lib, X, (int(rows), int(cols.value)))
+    ya = _take(lib, y, (int(rows),)).astype(np.float32)
+    return Xa, ya

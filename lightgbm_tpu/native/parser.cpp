@@ -1,0 +1,202 @@
+// Native text-data parser for lightgbm_tpu.
+//
+// TPU-native counterpart of the reference's C++ ingest machinery
+// (/root/reference/src/io/parser.cpp CSV/TSV/LibSVM parsers,
+// include/LightGBM/utils/text_reader.h buffered line reader): the hot
+// parse loop stays native while Python orchestrates.  Exposed as a tiny
+// C ABI consumed through ctypes (no pybind11 dependency).
+//
+// Locale-independent float parsing via strtod on the "C" locale contract
+// (mirroring Common::Atof, include/LightGBM/utils/common.h).
+//
+// Build: g++ -O3 -shared -fPIC -o _ltpu_parser.so parser.cpp
+#include <cmath>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <vector>
+
+namespace {
+
+// Read a whole file into memory; returns nullptr on failure.
+char* read_file(const char* path, size_t* out_len) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return nullptr;
+  std::fseek(f, 0, SEEK_END);
+  long len = std::ftell(f);
+  if (len < 0) { std::fclose(f); return nullptr; }
+  std::fseek(f, 0, SEEK_SET);
+  char* buf = static_cast<char*>(std::malloc(static_cast<size_t>(len) + 1));
+  if (!buf) { std::fclose(f); return nullptr; }
+  size_t got = std::fread(buf, 1, static_cast<size_t>(len), f);
+  std::fclose(f);
+  buf[got] = '\0';
+  *out_len = got;
+  return buf;
+}
+
+inline const char* skip_lines(const char* p, const char* end, long n) {
+  while (n > 0 && p < end) {
+    const char* nl = static_cast<const char*>(
+        std::memchr(p, '\n', static_cast<size_t>(end - p)));
+    if (!nl) return end;
+    p = nl + 1;
+    --n;
+  }
+  return p;
+}
+
+// Parse one field ending at `delim`/newline; empty or unparseable -> NaN.
+// The field is bounded FIRST: strtod skips leading whitespace (including
+// '\t' and '\n'), so an unbounded call would swallow the next field of a
+// tab-separated line when this one is empty.
+inline double parse_field(const char*& p, const char* end, char delim,
+                          bool* line_done) {
+  const char* q = p;
+  while (q < end && *q != delim && *q != '\n' && *q != '\r') ++q;
+  double v;
+  if (q == p) {
+    v = std::nan("");                       // empty field
+  } else {
+    char* next = nullptr;
+    v = std::strtod(p, &next);
+    const char* t = next;
+    while (t < q && (*t == ' ' || *t == '\t')) ++t;   // trailing whitespace ok
+    // junk, crossed bound, or trailing garbage ("1.5abc") -> NaN, matching
+    // the np.genfromtxt fallback
+    if (next == p || next > q || t != q) v = std::nan("");
+  }
+  if (q < end && *q == delim) {
+    p = q + 1;
+    *line_done = false;
+  } else {
+    while (q < end && *q == '\r') ++q;
+    p = (q < end && *q == '\n') ? q + 1 : q;
+    *line_done = true;
+  }
+  return v;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Parse a delimiter-separated numeric file -> row-major [rows, cols]
+// doubles (missing/na fields = NaN, genfromtxt semantics).  Returns the
+// row count (<0 on error); *out_data is malloc'd, caller frees via
+// ltpu_free.  cols = field count of the first data line.
+long ltpu_parse_delimited(const char* path, char delim, long skip,
+                          double** out_data, long* out_cols) {
+  size_t len = 0;
+  char* buf = read_file(path, &len);
+  if (!buf) return -1;
+  const char* end = buf + len;
+  const char* p = skip_lines(buf, end, skip);
+
+  // count columns from the first non-empty data line
+  long cols = 0;
+  {
+    const char* q = p;
+    while (q < end && (*q == '\n' || *q == '\r')) ++q;
+    if (q >= end) { std::free(buf); *out_cols = 0; return 0; }
+    const char* scan = q;
+    bool done = false;
+    while (!done && scan < end) {
+      parse_field(scan, end, delim, &done);
+      ++cols;
+    }
+  }
+
+  std::vector<double> data;
+  data.reserve(1 << 20);
+  long rows = 0;
+  while (p < end) {
+    if (*p == '\n' || *p == '\r') { ++p; continue; }
+    bool done = false;
+    long c = 0;
+    while (c < cols && !(done && c > 0)) {
+      data.push_back(parse_field(p, end, delim, &done));
+      ++c;
+    }
+    // inconsistent column count: fail loudly like np.genfromtxt
+    // (the Python wrapper falls back, which raises the descriptive error)
+    if (c < cols || !done) { std::free(buf); return -3; }
+    ++rows;
+  }
+  std::free(buf);
+
+  double* out = static_cast<double*>(std::malloc(data.size() * sizeof(double)));
+  if (!out && !data.empty()) return -2;
+  std::memcpy(out, data.data(), data.size() * sizeof(double));
+  *out_data = out;
+  *out_cols = cols;
+  return rows;
+}
+
+// Parse LibSVM "label idx:val ..." -> dense row-major [rows, max_idx+1]
+// doubles + labels.  Returns row count (<0 on error).
+long ltpu_parse_libsvm(const char* path, long skip, double** out_x,
+                       long* out_cols, double** out_labels) {
+  size_t len = 0;
+  char* buf = read_file(path, &len);
+  if (!buf) return -1;
+  const char* end = buf + len;
+  const char* start = skip_lines(buf, end, skip);
+
+  // pass 1: rows + max feature index
+  long rows = 0, max_idx = -1;
+  for (const char* p = start; p < end;) {
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    ++rows;
+    while (p < end && *p != '\n') {
+      if (*p == ':') {
+        const char* q = p - 1;
+        while (q > start && q[-1] >= '0' && q[-1] <= '9') --q;
+        long idx = std::strtol(q, nullptr, 10);
+        if (idx > max_idx) max_idx = idx;
+      }
+      ++p;
+    }
+  }
+  long cols = max_idx + 1;
+  double* X = static_cast<double*>(
+      std::calloc(static_cast<size_t>(rows) * (cols > 0 ? cols : 1),
+                  sizeof(double)));
+  double* y = static_cast<double*>(std::malloc(
+      static_cast<size_t>(rows) * sizeof(double)));
+  if ((!X && rows * cols > 0) || !y) { std::free(buf); return -2; }
+
+  // pass 2: fill
+  long r = 0;
+  for (const char* p = start; p < end && r < rows;) {
+    while (p < end && (*p == '\n' || *p == '\r')) ++p;
+    if (p >= end) break;
+    char* next = nullptr;
+    y[r] = std::strtod(p, &next);
+    p = next;
+    while (p < end && *p != '\n') {
+      while (p < end && *p == ' ') ++p;
+      if (p >= end || *p == '\n' || *p == '\r') break;
+      char* q = nullptr;
+      long idx = std::strtol(p, &q, 10);
+      if (q && q < end && *q == ':') {
+        double v = std::strtod(q + 1, &next);
+        if (idx >= 0 && idx < cols) X[r * cols + idx] = v;
+        p = next;
+      } else {
+        while (p < end && *p != ' ' && *p != '\n' && *p != '\r') ++p;
+      }
+    }
+    ++r;
+  }
+  std::free(buf);
+  *out_x = X;
+  *out_labels = y;
+  *out_cols = cols;
+  return rows;
+}
+
+void ltpu_free(double* p) { std::free(p); }
+
+}  // extern "C"

@@ -135,7 +135,7 @@ def find_best_splits(hist: jnp.ndarray,
     tc = leaf_count[:, None]
 
     l1, l2 = params.lambda_l1, params.lambda_l2
-    min_d = float(params.min_data_in_leaf)
+    min_d = params.min_data_in_leaf * 1.0
     min_h = params.min_sum_hessian_in_leaf
 
     parent_gain = leaf_split_gain(leaf_sum_grad, leaf_sum_hess, l1, l2)  # [L]
@@ -266,7 +266,7 @@ def _categorical_splits(g, h, c, tg, th, tc, num_bins, valid_bin,
     L, F, B = g.shape
     l1 = params.lambda_l1
     l2 = params.lambda_l2 + params.cat_l2
-    min_d = float(params.min_data_in_leaf)
+    min_d = params.min_data_in_leaf * 1.0
     min_h = params.min_sum_hessian_in_leaf
 
     occupied = valid_bin[None] & (c > 0)                                 # [L, F, B]

@@ -183,7 +183,7 @@ def test_save_load_pickle(tmp_path):
 
 
 def test_dump_model_json():
-    X, y = _binary_data(n=500)
+    X, y = _binary_data()
     bst = lgb.train({"objective": "binary", "num_leaves": 7},
                     lgb.Dataset(X, label=y), 3, verbose_eval=False)
     d = bst.dump_model()
@@ -193,7 +193,7 @@ def test_dump_model_json():
 
 
 def test_cv():
-    X, y = _binary_data(n=600)
+    X, y = _binary_data()
     res = lgb.cv({"objective": "binary", "metric": "binary_logloss",
                   "num_leaves": 7}, lgb.Dataset(X, label=y),
                  num_boost_round=5, nfold=3, verbose_eval=False)
@@ -202,7 +202,7 @@ def test_cv():
 
 
 def test_dart():
-    X, y = _binary_data(n=800)
+    X, y = _binary_data()
     train = lgb.Dataset(X, label=y)
     evals = {}
     lgb.train({"objective": "binary", "boosting": "dart", "metric": "auc",
@@ -213,7 +213,7 @@ def test_dart():
 
 
 def test_goss():
-    X, y = _binary_data(n=2000)
+    X, y = _binary_data()
     train = lgb.Dataset(X, label=y)
     evals = {}
     lgb.train({"objective": "binary", "boosting": "goss", "metric": "auc",
@@ -224,7 +224,7 @@ def test_goss():
 
 
 def test_rf():
-    X, y = _binary_data(n=1500)
+    X, y = _binary_data()
     train = lgb.Dataset(X, label=y)
     evals = {}
     bst = lgb.train({"objective": "binary", "boosting": "rf", "metric": "auc",
@@ -238,7 +238,7 @@ def test_rf():
 
 
 def test_custom_objective_fobj():
-    X, y = _binary_data(n=800)
+    X, y = _binary_data()
     train = lgb.Dataset(X, label=y)
 
     def logloss_obj(score, dataset):
@@ -264,7 +264,7 @@ def test_feature_importance():
 
 
 def test_pred_leaf_and_contrib():
-    X, y = _binary_data(n=400)
+    X, y = _binary_data()
     bst = lgb.train({"objective": "binary", "num_leaves": 7},
                     lgb.Dataset(X, label=y), 4, verbose_eval=False)
     leaves = bst.predict(X[:30], pred_leaf=True)
@@ -278,7 +278,7 @@ def test_pred_leaf_and_contrib():
 
 
 def test_weights_change_fit():
-    X, y = _binary_data(n=600)
+    X, y = _binary_data()
     w = np.where(y > 0, 10.0, 0.1).astype(np.float32)
     bst = lgb.train({"objective": "binary", "num_leaves": 7},
                     lgb.Dataset(X, label=y, weight=w), 8, verbose_eval=False)
