@@ -185,10 +185,11 @@ class GBDT:
         growth = self.growth
         if self.mesh_ctx is None:
             # once-per-dataset transposed bins for the Pallas kernels
-            from ..learner.serial import resolve_backend
+            from ..learner.serial import default_hist_mode, resolve_backend
             from ..ops.pallas_histogram import transpose_bins
             self._bins_t = None
-            if resolve_backend(self.device_data, growth.num_leaves) == "pallas":
+            if resolve_backend(self.device_data, growth.num_leaves,
+                               hist_mode=default_hist_mode()) == "pallas":
                 self._bins_t = jax.jit(transpose_bins)(self.device_data.bins)
             def _raw_build(dd, grad, hess, bag, fmask, bins_t=None):
                 from ..learner.serial import default_hist_mode

@@ -185,7 +185,11 @@ def load_file(path: str, config: Config,
     row shard, mappers allgathered so every rank bins identically
     (`dataset_loader.cpp:816-880`; see ``io/distributed.py``)."""
     bin_path = path + ".bin.npz"
+    # the cache stores whatever one process binned — single-machine only
+    # (a shard cache would hand other ranks the wrong rows, and all ranks
+    # would race-write the same file)
     if (config.enable_load_from_binary_file and reference is None
+            and num_machines == 1
             and os.path.exists(bin_path)
             and os.path.getmtime(bin_path) >= os.path.getmtime(path)):
         log_info(f"loading binary cache {bin_path}")
@@ -205,10 +209,17 @@ def load_file(path: str, config: Config,
     # distributed row sharding (dataset_loader.cpp:639-742): pre-partition
     # means each rank already has its own file; otherwise mod-rank rows
     if num_machines > 1 and not config.is_pre_partition:
+        if q is not None or query_inline is not None:
+            raise ValueError(
+                "mod-rank row sharding would split ranking queries; use "
+                "is_pre_partition=true with per-rank files (reference "
+                "dataset_loader.cpp:639-742 contract)")
         sel = np.arange(rank, len(X), num_machines)
         X, label = X[sel], label[sel]
         if weight is not None:
             weight = weight[sel]
+        if init_score is not None:
+            init_score = init_score[sel]
 
     md = Metadata()
     md.set_field("label", label)
@@ -233,6 +244,12 @@ def load_file(path: str, config: Config,
         from .distributed import find_bins_distributed
         mappers = find_bins_distributed(X, config, rank, num_machines,
                                         allgather, cat_cols)
+        if len(mappers) < X.shape[1]:
+            # feature count synced DOWN to the min across ranks
+            # (GlobalSyncUpByMin semantics): drop this rank's extras
+            X = X[:, :len(mappers)]
+            feature_names = feature_names[:len(mappers)]
+            cat_cols = [c for c in cat_cols if c < len(mappers)]
     ds = BinnedDataset.from_raw(X, config, categorical_features=cat_cols,
                                 feature_names=feature_names, metadata=md,
                                 mappers=mappers)

@@ -45,7 +45,8 @@ import jax.numpy as jnp
 from ..io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 from ..io.device import DeviceData
 from ..ops.pallas_histogram import (bin_stride, default_backend,
-                                    hist_active_pallas, hist_active_scatter,
+                                    fused_config_ok, hist_active_pallas,
+                                    hist_active_scatter, hist_route_pallas,
                                     pack_values, pallas_config_ok,
                                     transpose_bins)
 from ..ops.pallas_route import route_rows_pallas, route_rows_xla
@@ -98,6 +99,8 @@ class _WaveState(NamedTuple):
     leaf_is_left: jnp.ndarray    # [L] bool
     hist_state: jnp.ndarray      # [L, F_local, B, 3] per-leaf histograms
     best: SplitResult            # [L] cached best split per leaf
+    pend_sel: jnp.ndarray        # [L] bool: splits decided last wave,
+    pend_new: jnp.ndarray        # [L] i32  not yet applied to the rows
     act_small: jnp.ndarray       # [A] leaf ids to histogram this wave (-1 pad)
     act_parent: jnp.ndarray      # [A] slot holding the parent hist (-1: none)
     act_sibling: jnp.ndarray     # [A] sibling leaf id (-1: none)
@@ -258,6 +261,25 @@ def apply_hist_wave(hist_state, new_h, act_small, act_parent, act_sibling,
     return hist_state, ids, grid
 
 
+def make_fused_fn(data: DeviceData, grad, hess, hist_mode: str,
+                  bins_t: jnp.ndarray):
+    """Fused route+hist closure ``(leaf2, best, sel, new_id, active) ->
+    (new_h, leaf2_new)`` — one bins stream per wave instead of two."""
+    vals = pack_values(grad, hess, hist_mode)
+
+    def fused(leaf2, best: SplitResult, sel, new_id, active):
+        h, leaf2_new = hist_route_pallas(
+            bins_t, vals, leaf2, active,
+            best.feature, best.threshold, best.default_left,
+            best.is_categorical, best.cat_mask, sel, new_id,
+            data.missing_types, data.nan_bins, data.default_bins,
+            data.feat_group, data.feat_offset, data.num_bins,
+            num_features=data.num_groups, max_bins=data.group_max_bins,
+            mode=hist_mode)
+        return h, leaf2_new
+    return fused
+
+
 def make_serial_strategy(data: DeviceData, grad, hess, params: GrowthParams,
                          feature_mask, psum_fn=None, backend: str = "auto",
                          hist_mode: Optional[str] = None,
@@ -276,22 +298,34 @@ def make_serial_strategy(data: DeviceData, grad, hess, params: GrowthParams,
         new_h = hist_fn(hist_leaf, act_small)            # [A, G, Bg, 3]
         if psum_fn is not None:
             new_h = psum_fn(new_h)
-        hist_state, ids, grid = apply_hist_wave(
-            hist_state, new_h, act_small, act_parent, act_sibling, L)
-        safe = jnp.clip(ids, 0, L - 1)
-        if data.is_bundled:
-            from ..ops.histogram import unbundle_grid
-            grid = unbundle_grid(grid, lsg[safe], lsh[safe], lc[safe],
-                                 data.feat_group, data.feat_offset,
-                                 data.num_bins, data.default_bins,
-                                 bin_stride(data.max_bins))
-        res = find_best_splits(grid, lsg[safe], lsh[safe], lc[safe],
-                               data.num_bins, data.missing_types,
-                               data.default_bins, data.is_categorical,
-                               params.split, feature_mask,
-                               any_categorical=data.has_categorical)
-        return hist_state, ids, res
+        return rescan_changed(data, params, feature_mask, hist_state, new_h,
+                              act_small, act_parent, act_sibling,
+                              lsg, lsh, lc)
     return wave
+
+
+def rescan_changed(data: DeviceData, params: GrowthParams, feature_mask,
+                   hist_state, new_h, act_small, act_parent, act_sibling,
+                   lsg, lsh, lc):
+    """Shared post-histogram flow for every wave path (serial strategy and
+    the fused kernel): sibling subtraction, EFB unbundle, rescan of the
+    changed leaves."""
+    L = hist_state.shape[0]
+    hist_state, ids, grid = apply_hist_wave(
+        hist_state, new_h, act_small, act_parent, act_sibling, L)
+    safe = jnp.clip(ids, 0, L - 1)
+    if data.is_bundled:
+        from ..ops.histogram import unbundle_grid
+        grid = unbundle_grid(grid, lsg[safe], lsh[safe], lc[safe],
+                             data.feat_group, data.feat_offset,
+                             data.num_bins, data.default_bins,
+                             bin_stride(data.max_bins))
+    res = find_best_splits(grid, lsg[safe], lsh[safe], lc[safe],
+                           data.num_bins, data.missing_types,
+                           data.default_bins, data.is_categorical,
+                           params.split, feature_mask,
+                           any_categorical=data.has_categorical)
+    return hist_state, ids, res
 
 
 def build_tree(data: DeviceData,
@@ -321,7 +355,8 @@ def build_tree(data: DeviceData,
     Gh = (num_hist_features if num_hist_features is not None
           else data.num_groups)
 
-    backend = resolve_backend(data, L, hist_backend)
+    mode = hist_mode or default_hist_mode()
+    backend = resolve_backend(data, L, hist_backend, mode)
     if backend == "pallas" and bins_t is None:
         bins_t = transpose_bins(data.bins)
     n_pad = bins_t.shape[1] if backend == "pallas" else n
@@ -371,12 +406,24 @@ def build_tree(data: DeviceData,
     else:
         plan, A_tail = [], _round8(max(1, L // 2))
     wave_cap = params.wave_size if params.wave_size > 0 else L
-    if strategy is None:
+    # fused route+hist: one bins stream per wave (serial Pallas path with
+    # every stored column in a single kernel tile)
+    fused = (strategy is None and psum_fn is None and backend == "pallas"
+             and fused_config_ok(bins_t.shape[0], data.group_max_bins, L,
+                                 mode))
+    fused_fn = (make_fused_fn(data, grad, hess, mode, bins_t)
+                if fused else None)
+    if strategy is None and not fused:
         strategy = make_serial_strategy(data, grad, hess, params,
                                         feature_mask, psum_fn=psum_fn,
                                         backend=backend, bins_t=bins_t,
                                         hist_mode=hist_mode)
     route_fn = make_route_fn(data, backend, bins_t)
+
+    def scan_changed(hist_state, new_h, s, lsg, lsh, lc):
+        return rescan_changed(data, params, feature_mask, hist_state, new_h,
+                              s.act_small, s.act_parent, s.act_sibling,
+                              lsg, lsh, lc)
 
     A0 = plan[0] if plan else A_tail
     state = _WaveState(
@@ -391,6 +438,8 @@ def build_tree(data: DeviceData,
         leaf_is_left=jnp.zeros(L, bool),
         hist_state=jnp.zeros((L, Gh, Bh, 3), jnp.float32),
         best=_empty_best(L, B),
+        pend_sel=jnp.zeros(L, bool),
+        pend_new=jnp.zeros(L, jnp.int32),
         act_small=jnp.full(A0, -1, jnp.int32).at[0].set(0),  # root wave
         act_parent=jnp.full(A0, -1, jnp.int32),
         act_sibling=jnp.full(A0, -1, jnp.int32),
@@ -398,10 +447,21 @@ def build_tree(data: DeviceData,
     )
 
     def body(s: _WaveState, A_out: int) -> _WaveState:
-        # --- 1-3: histogram active leaves, subtract siblings, rescan ----
-        hist_state, ids, res = strategy(
-            s.hist_state, s.leaf2[1], s.act_small, s.act_parent,
-            s.act_sibling, s.leaf_sum_grad, s.leaf_sum_hess, s.leaf_count)
+        # --- 0-3: apply last wave's pending splits to the rows, then
+        # histogram the active leaves, subtract siblings, rescan.  The
+        # fused kernel does the route inside the histogram's bins stream.
+        if fused:
+            new_h, leaf2 = fused_fn(s.leaf2, s.best, s.pend_sel,
+                                    s.pend_new, s.act_small)
+            hist_state, ids, res = scan_changed(
+                s.hist_state, new_h, s, s.leaf_sum_grad, s.leaf_sum_hess,
+                s.leaf_count)
+        else:
+            leaf2 = route_fn(s.leaf2, s.best, s.pend_sel, s.pend_new)
+            hist_state, ids, res = strategy(
+                s.hist_state, leaf2[1], s.act_small, s.act_parent,
+                s.act_sibling, s.leaf_sum_grad, s.leaf_sum_hess,
+                s.leaf_count)
         best = jax.tree.map(
             lambda cur, new: cur.at[
                 jnp.where(ids >= 0, ids, L)].set(new, mode="drop"),
@@ -473,9 +533,10 @@ def build_tree(data: DeviceData,
         lp = lp.at[new_id].set(node_idx, mode="drop")
         lil = lil.at[new_id].set(False, mode="drop")
 
-        # --- 7: route rows (one kernel pass for both leaf vectors) ------
-        leaf2 = route_fn(s.leaf2, best, sel,
-                         jnp.where(sel, new_id, 0).astype(jnp.int32))
+        # --- 7: this wave's splits become the pending route, applied at
+        # the start of the next wave (or post-loop finalization)
+        pend_sel = sel
+        pend_new = jnp.where(sel, new_id, 0).astype(jnp.int32)
 
         # --- 8: next wave's active sets (smaller child + subtraction) ---
         # the smaller child gets histogrammed; the sibling is derived from
@@ -496,6 +557,7 @@ def build_tree(data: DeviceData,
             leaf_sum_grad=lsg, leaf_sum_hess=lsh, leaf_count=lc,
             leaf_depth=ld, leaf_value=lv, leaf_parent=lp, leaf_is_left=lil,
             hist_state=hist_state, best=best,
+            pend_sel=pend_sel, pend_new=pend_new,
             act_small=act_small, act_parent=act_parent,
             act_sibling=act_sibling,
             tree=t)
@@ -510,6 +572,10 @@ def build_tree(data: DeviceData,
         return (~s.done) & (s.nl < L)
 
     final = jax.lax.while_loop(cond, lambda s: body(s, A_tail), state)
+    # apply the last wave's pending splits before reading row_leaf
+    leaf2_final = route_fn(final.leaf2, final.best, final.pend_sel,
+                           final.pend_new)
+    final = final._replace(leaf2=leaf2_final)
     return final.tree._replace(
         leaf_value=final.leaf_value,
         leaf_count=final.leaf_count.astype(jnp.int32),

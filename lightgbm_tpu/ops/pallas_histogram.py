@@ -315,3 +315,190 @@ def default_backend() -> str:
     if forced:
         return forced
     return "pallas" if jax.default_backend() == "tpu" else "scatter"
+
+
+# ---------------------------------------------------------------------------
+# Fused route + histogram kernel: one bins stream per wave instead of two
+# ---------------------------------------------------------------------------
+def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
+                       cat_ref, spread_ref, out_ref, leaf2_out_ref, *,
+                       n_cols: int, B: int, Bcat: int, pad_cols: int):
+    """Apply the previous wave's pending splits to the leaf vectors, then
+    histogram the active leaves — both from ONE VMEM-resident bins tile.
+    The route logic matches ``ops/pallas_route.py`` (same table layout)."""
+    from .pallas_route import (_T_GROUP, _T_THR, _T_DL, _T_ISCAT, _T_SEL,
+                               _T_NEWID, _T_OFF, _T_NB, _T_DB, _T_MT,
+                               _T_NANB)
+    from ..io.binning import MISSING_NAN, MISSING_ZERO
+    rt = pl.program_id(0)
+
+    @pl.when(rt == 0)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    binsf32 = bins_ref[:].astype(jnp.int32).astype(jnp.float32)  # [G, T]
+    G_pad, T = binsf32.shape
+    L_pad = rtabs_ref.shape[1]
+
+    # ---- route (previous wave's pending splits) -----------------------
+    leaf = leaf2_ref[0:1, :]
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
+    ohL = (iota_l == leaf).astype(jnp.float32)
+    sel16 = jnp.dot(rtabs_ref[:], ohL, preferred_element_type=jnp.float32)
+    g_row = sel16[_T_GROUP:_T_GROUP + 1, :]
+    thr = sel16[_T_THR:_T_THR + 1, :]
+    dl = sel16[_T_DL:_T_DL + 1, :]
+    iscat = sel16[_T_ISCAT:_T_ISCAT + 1, :]
+    selm = sel16[_T_SEL:_T_SEL + 1, :]
+    new_id = sel16[_T_NEWID:_T_NEWID + 1, :]
+    off = sel16[_T_OFF:_T_OFF + 1, :]
+    nb = sel16[_T_NB:_T_NB + 1, :]
+    db = sel16[_T_DB:_T_DB + 1, :]
+    mt = sel16[_T_MT:_T_MT + 1, :]
+    nanb = sel16[_T_NANB:_T_NANB + 1, :]
+
+    iota_g = jax.lax.broadcasted_iota(
+        jnp.int32, (G_pad, T), 0).astype(jnp.float32)
+    ohG = jnp.where(iota_g == g_row, 1.0, 0.0)
+    c = jnp.sum(ohG * binsf32, axis=0, keepdims=True)
+
+    one = jnp.ones_like(c)
+    zero = jnp.zeros_like(c)
+    rank = c - off
+    gt_db = jnp.where(rank >= db, one, zero)
+    in_range = jnp.where((rank >= 0) & (rank < nb - 1), one, zero)
+    b_bundled = jnp.where(in_range > 0.5, rank + gt_db, db)
+    b = jnp.where(off < -0.5, c, b_bundled)
+    is_missing = jnp.where(
+        ((mt == float(MISSING_NAN)) & (b == nanb))
+        | ((mt == float(MISSING_ZERO)) & (b == db)), one, zero)
+    catrow = jnp.dot(cat_ref[:], ohL, preferred_element_type=jnp.float32)
+    iota_b = jax.lax.broadcasted_iota(
+        jnp.int32, (Bcat, T), 0).astype(jnp.float32)
+    cat_left = jnp.sum(jnp.where(iota_b == b, catrow, 0.0), axis=0,
+                       keepdims=True)
+    le_thr = jnp.where(b <= thr, one, zero)
+    num_left = jnp.where(is_missing > 0.5, dl, le_thr)
+    go_left = jnp.where(iscat > 0.5, cat_left, num_left)
+    in_tree = jnp.where(leaf >= 0, one, zero)
+    moved = selm * (one - jnp.minimum(go_left, one)) * in_tree
+    nid = new_id.astype(jnp.int32)
+    rl = jnp.where(moved > 0.5, nid, leaf)
+    hl_old = leaf2_ref[1:2, :]
+    hl = jnp.where(hl_old >= 0, rl, hl_old)
+    leaf2_out_ref[0:1, :] = rl
+    leaf2_out_ref[1:2, :] = hl
+
+    # ---- histogram with the routed in-bag leaves ----------------------
+    binsrep = jnp.dot(spread_ref[:], binsf32.astype(jnp.bfloat16),
+                      preferred_element_type=jnp.float32)
+    brow = jax.lax.broadcasted_iota(jnp.int32, binsrep.shape, 0) & (B - 1)
+    oh = (binsrep == brow.astype(jnp.float32)).astype(jnp.bfloat16)
+    m = (hl.reshape(T, 1) == active_ref[:]).astype(jnp.bfloat16)
+    vals = vals_ref[:]
+    blocks = [m * vals[:, ci:ci + 1].astype(jnp.bfloat16)
+              for ci in range(n_cols)]
+    if pad_cols:
+        blocks.append(jnp.zeros((T, pad_cols), jnp.bfloat16))
+    vw = jnp.concatenate(blocks, axis=1)
+    out_ref[:] += jax.lax.dot_general(
+        oh, vw, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)
+
+
+def fused_config_ok(num_groups: int, max_bins: int, num_leaves: int,
+                    mode: str) -> bool:
+    """Fusion needs the whole feature set in one tile (the route reads the
+    split feature's column, which may live in any tile) plus the usual
+    kernel bounds."""
+    if not pallas_config_ok(max_bins, num_leaves, mode):
+        return False
+    B = bin_stride(max_bins)
+    _, _, cols = _col_layout(min(max(1, num_leaves // 2), 128), mode)
+    ft_cap = max(1, _ACC_VMEM_BYTES // (B * cols * 4))
+    return num_groups <= ft_cap
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_features", "max_bins", "mode", "row_tile",
+                     "interpret"))
+def hist_route_pallas(bins_t, vals, leaf2, active,
+                      feature, threshold, default_left, is_categorical,
+                      cat_mask, sel, new_id, missing_types, nan_bins,
+                      default_bins, feat_group, feat_offset, num_bins_arr,
+                      *, num_features: int, max_bins: int,
+                      mode: str = "hilo", row_tile: int = DEFAULT_ROW_TILE,
+                      interpret: bool = False):
+    """Fused previous-wave routing + active-leaf histograms.
+
+    -> ``(hist [A, F, B, 3] f32, leaf2_new [2, n_pad] i32)``.  Same
+    contracts as :func:`hist_active_pallas` +
+    ``ops.pallas_route.route_rows_pallas`` composed (route first).
+    Requires ``fused_config_ok``.
+    """
+    from .pallas_route import _T_ROWS, _leaf_tables
+    F_pad, n_pad = bins_t.shape
+    C = vals.shape[1]
+    A = active.shape[0]
+    B = bin_stride(max_bins)
+    T = row_tile
+    assert n_pad % T == 0 and leaf2.shape == (2, n_pad)
+
+    _, A_pad, cols = _col_layout(A, "hilo" if C == 5 else "bf16")
+    pad_cols = cols - C * A_pad
+    L = feature.shape[0]
+    L_pad = _round_up(max(L, 8), LANE)
+    Bcat = cat_mask.shape[1]
+
+    rtabs = _leaf_tables(feature, threshold, default_left, is_categorical,
+                         sel, new_id, missing_types, nan_bins, default_bins,
+                         feat_group, feat_offset, num_bins_arr, L_pad)
+    cat = jnp.zeros((Bcat, L_pad), jnp.float32)
+    cat = cat.at[:, :L].set(cat_mask.T.astype(jnp.float32))
+    act = jnp.full((1, A_pad), -2, jnp.int32)
+    act = jax.lax.dynamic_update_slice(
+        act, active.astype(jnp.int32)[None, :], (0, 0))
+    spread = jnp.asarray(_spread_matrix(F_pad, B))
+
+    out, leaf2_new = pl.pallas_call(
+        functools.partial(_hist_route_kernel, n_cols=C, B=B, Bcat=Bcat,
+                          pad_cols=pad_cols),
+        grid=(n_pad // T,),
+        in_specs=[
+            pl.BlockSpec((1, A_pad), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((F_pad, T), lambda r: (0, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((T, C), lambda r: (r, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, T), lambda r: (0, r),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((_T_ROWS, L_pad), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((Bcat, L_pad), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((F_pad * B, F_pad), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=(
+            pl.BlockSpec((F_pad * B, cols), lambda r: (0, 0),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((2, T), lambda r: (0, r),
+                         memory_space=pltpu.VMEM),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((F_pad * B, cols), jnp.float32),
+            jax.ShapeDtypeStruct((2, n_pad), jnp.int32),
+        ),
+        interpret=interpret,
+    )(act, bins_t, vals, leaf2, rtabs, cat, spread)
+
+    out = out.reshape(F_pad, B, cols)[:, :, :C * A_pad]
+    out = out.reshape(F_pad, B, C, A_pad)
+    out = out.transpose(3, 0, 1, 2)[:A, :num_features]
+    if C == 5:
+        g = out[..., 0] + out[..., 1]
+        h = out[..., 2] + out[..., 3]
+        out = jnp.stack([g, h, out[..., 4]], axis=-1)
+    return out, leaf2_new
