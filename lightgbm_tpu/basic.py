@@ -210,7 +210,8 @@ class Booster:
             self._valid_sets: List[Dataset] = []
             self._name_valid_sets: List[str] = []
         elif model_file is not None:
-            with open(model_file) as f:
+            from .utils.file_io import open_read
+            with open_read(model_file) as f:
                 text = f.read()
             self._init_from_string(text)
         elif model_str is not None:

@@ -923,7 +923,8 @@ class GBDT:
         return body
 
     def save_model(self, path: str, num_iteration: int = -1) -> None:
-        with open(path, "w") as f:
+        from ..utils.file_io import open_write
+        with open_write(path) as f:
             f.write(self.save_model_to_string(num_iteration))
 
     def load_model_from_string(self, text: str) -> None:

@@ -45,7 +45,8 @@ def parse_cli_args(argv: List[str]) -> Dict[str, str]:
 def parse_config_file(path: str) -> Dict[str, str]:
     """key=value lines, '#' comments (application.cpp:60-77)."""
     out: Dict[str, str] = {}
-    with open(path) as f:
+    from .utils.file_io import open_read
+    with open_read(path) as f:
         for line in f:
             line = line.split("#", 1)[0].strip()
             if not line or "=" not in line:
@@ -163,7 +164,8 @@ def _run_convert(cfg: Config, params) -> None:
     booster = Booster(params=dict(params), model_file=cfg.input_model)
     code = model_to_ifelse(booster._gbdt)
     out = cfg.convert_model
-    with open(out, "w") as f:
+    from .utils.file_io import open_write
+    with open_write(out) as f:
         f.write(code)
     log_info(f"model converted to if-else code at {out}")
 

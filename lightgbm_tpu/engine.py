@@ -56,7 +56,8 @@ def train(params: Dict[str, Any], train_set: Dataset,
             if "Tree=" in init_model or "\n" in init_model:
                 init_str = init_model
             else:
-                with open(init_model) as f:
+                from .utils.file_io import open_read
+                with open_read(init_model) as f:
                     init_str = f.read()
         elif isinstance(init_model, Booster):
             init_str = init_model.model_to_string()

@@ -19,6 +19,7 @@ from typing import List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..config import Config
+from ..utils.file_io import localize
 from ..utils.log import log_info, log_warning
 from .dataset import BinnedDataset, Metadata
 
@@ -77,6 +78,7 @@ def parse_file(path: str, config: Config
                ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
                           Optional[np.ndarray], List[str], List[int]]:
     """-> (X, label, weight, query, feature_names, categorical_cols)."""
+    path = localize(path)          # remote schemes -> temp copy (file_io)
     fmt = detect_format(path, config.has_header)
     header_names: Optional[List[str]] = None
     skip = 0
@@ -167,8 +169,9 @@ def _parse_libsvm(path: str, skip: int) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def _load_side_file(path: str, dtype=np.float32) -> Optional[np.ndarray]:
-    if os.path.exists(path):
-        return np.loadtxt(path, dtype=dtype).reshape(-1)
+    from ..utils.file_io import exists as io_exists
+    if io_exists(path):
+        return np.loadtxt(localize(path), dtype=dtype).reshape(-1)
     return None
 
 
@@ -185,11 +188,12 @@ def load_file(path: str, config: Config,
     row shard, mappers allgathered so every rank bins identically
     (`dataset_loader.cpp:816-880`; see ``io/distributed.py``)."""
     bin_path = path + ".bin.npz"
-    # the cache stores whatever one process binned — single-machine only
-    # (a shard cache would hand other ranks the wrong rows, and all ranks
-    # would race-write the same file)
+    is_local = "://" not in path.split("/")[0]
+    # the cache stores whatever one process binned — single-machine,
+    # local-FS only (a shard cache would hand other ranks the wrong rows,
+    # and all ranks would race-write the same file)
     if (config.enable_load_from_binary_file and reference is None
-            and num_machines == 1
+            and num_machines == 1 and is_local
             and os.path.exists(bin_path)
             and os.path.getmtime(bin_path) >= os.path.getmtime(path)):
         log_info(f"loading binary cache {bin_path}")
@@ -214,12 +218,17 @@ def load_file(path: str, config: Config,
                 "mod-rank row sharding would split ranking queries; use "
                 "is_pre_partition=true with per-rank files (reference "
                 "dataset_loader.cpp:639-742 contract)")
-        sel = np.arange(rank, len(X), num_machines)
+        n_full = len(X)
+        sel = np.arange(rank, n_full, num_machines)
         X, label = X[sel], label[sel]
         if weight is not None:
             weight = weight[sel]
         if init_score is not None:
-            init_score = init_score[sel]
+            # init_score is flat [n*num_class] in class-major blocks
+            # (Metadata convention): take this rank's rows per block
+            K = max(1, len(init_score) // n_full)
+            init_score = np.concatenate(
+                [init_score[k * n_full + sel] for k in range(K)])
 
     md = Metadata()
     md.set_field("label", label)

@@ -215,12 +215,14 @@ def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
 def make_route_fn(data: DeviceData, backend: str,
                   bins_t: Optional[jnp.ndarray] = None):
     """Per-wave split application closure ``(leaf2, best, sel, new_id)
-    -> leaf2`` (the DataPartition::Split analog)."""
+    -> leaf2`` (the DataPartition::Split analog).  A ``lax.cond`` skips
+    the full-data pass when no splits are pending (the root wave and
+    drained tail waves)."""
     if backend == "pallas":
         if bins_t is None:
             bins_t = transpose_bins(data.bins)
 
-        def route_fn(leaf2, best: SplitResult, sel, new_id):
+        def route_impl(leaf2, best: SplitResult, sel, new_id):
             return route_rows_pallas(
                 bins_t, leaf2, best.feature, best.threshold,
                 best.default_left, best.is_categorical, best.cat_mask,
@@ -228,13 +230,20 @@ def make_route_fn(data: DeviceData, backend: str,
                 data.default_bins, data.feat_group, data.feat_offset,
                 data.num_bins)
     else:
-        def route_fn(leaf2, best: SplitResult, sel, new_id):
+        def route_impl(leaf2, best: SplitResult, sel, new_id):
             return route_rows_xla(
                 data.bins, leaf2, best.feature, best.threshold,
                 best.default_left, best.is_categorical, best.cat_mask,
                 sel, new_id, data.missing_types, data.nan_bins,
                 data.default_bins, data.feat_group, data.feat_offset,
                 data.num_bins)
+
+    def route_fn(leaf2, best: SplitResult, sel, new_id):
+        return jax.lax.cond(
+            jnp.any(sel),
+            lambda l2: route_impl(l2, best, sel, new_id),
+            lambda l2: l2,
+            leaf2)
     return route_fn
 
 

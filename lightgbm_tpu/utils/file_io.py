@@ -1,0 +1,96 @@
+"""Virtual file IO — pluggable path schemes for remote filesystems.
+
+Counterpart of the reference's ``VirtualFileWriter``/``VirtualFileReader``
+(`/root/reference/src/io/file_io.cpp`, `include/LightGBM/utils/file_io.h`),
+which routes file access through an HDFS client when built with
+``USE_HDFS`` and the path starts with ``hdfs://``.  Here the seam is a
+scheme registry: anything may register an opener for a URL prefix
+(``hdfs://``, ``gs://``, ...) and every loader / model-IO call routes
+through :func:`open_read` / :func:`open_write` / :func:`localize`.
+
+The local filesystem is the built-in default.  ``localize`` exists for
+consumers that need a real OS path (the native C parser mmap-reads the
+file); remote schemes materialize to a temp file first — the analog of
+the fork's per-rank HDFS shard download
+(`src/application/application.cpp:168-237`).
+"""
+from __future__ import annotations
+
+import atexit
+import os
+import shutil
+import tempfile
+from typing import Callable, Dict, List, Optional
+
+# scheme -> opener(path, mode) -> file-like
+_OPENERS: Dict[str, Callable] = {}
+_TEMPS: List[str] = []
+
+
+@atexit.register
+def _cleanup_temps() -> None:
+    for t in _TEMPS:
+        try:
+            os.unlink(t)
+        except OSError:
+            pass
+
+
+def register_scheme(prefix: str, opener: Callable) -> None:
+    """Register ``opener(path, mode)`` for paths starting with ``prefix``
+    (e.g. ``"hdfs://"``)."""
+    _OPENERS[prefix] = opener
+
+
+def _find_opener(path: str) -> Optional[Callable]:
+    for prefix, opener in _OPENERS.items():
+        if path.startswith(prefix):
+            return opener
+    if "://" in path and "/" not in path.split("://", 1)[0]:
+        scheme = path.split("://", 1)[0]
+        raise ValueError(
+            f"no opener registered for scheme {scheme!r} "
+            f"(register one with lightgbm_tpu.utils.file_io.register_scheme)")
+    return None
+
+
+def open_read(path: str, binary: bool = False):
+    opener = _find_opener(path)
+    mode = "rb" if binary else "r"
+    if opener is not None:
+        return opener(path, mode)
+    return open(path, mode)
+
+
+def open_write(path: str, binary: bool = False):
+    opener = _find_opener(path)
+    mode = "wb" if binary else "w"
+    if opener is not None:
+        return opener(path, mode)
+    return open(path, mode)
+
+
+def exists(path: str) -> bool:
+    opener = _find_opener(path)
+    if opener is not None:
+        try:
+            with opener(path, "rb"):
+                return True
+        except (OSError, IOError):
+            return False
+    return os.path.exists(path)
+
+
+def localize(path: str) -> str:
+    """Return a real OS path for ``path``: identity for local files,
+    a temp-file copy for registered remote schemes (per-rank shard
+    download, `application.cpp:215-237` analog)."""
+    opener = _find_opener(path)
+    if opener is None:
+        return path
+    suffix = os.path.splitext(path)[1]
+    fd, tmp = tempfile.mkstemp(suffix=suffix)
+    _TEMPS.append(tmp)                      # deleted at interpreter exit
+    with os.fdopen(fd, "wb") as dst, opener(path, "rb") as src:
+        shutil.copyfileobj(src, dst)
+    return tmp
