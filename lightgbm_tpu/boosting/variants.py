@@ -223,6 +223,7 @@ def create_boosting(config: Config, train_set=None, objective=None, fobj=None):
         config.boosting_type]
     booster = cls(config, train_set, objective, fobj)
     if config.input_model:
-        with open(config.input_model) as f:
+        from ..utils.file_io import open_read
+        with open_read(config.input_model) as f:
             booster.load_model_from_string(f.read())
     return booster

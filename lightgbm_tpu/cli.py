@@ -117,7 +117,9 @@ def _run_predict(cfg: Config, params) -> None:
     out = np.asarray(out)
     if out.ndim == 1:
         out = out[:, None]
-    np.savetxt(cfg.output_result, out, delimiter="\t", fmt="%.9g")
+    from .utils.file_io import open_write
+    with open_write(cfg.output_result) as _f:
+        np.savetxt(_f, out, delimiter="\t", fmt="%.9g")
     log_info(f"finished prediction; results saved to {cfg.output_result}")
 
 

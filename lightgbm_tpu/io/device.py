@@ -45,6 +45,7 @@ class DeviceData(NamedTuple):
     has_categorical: bool = True   # static: lets the split scan drop cat work
     max_group_bins: int = 0     # static: max per-GROUP bins (0 -> max_bins)
     is_bundled: bool = False    # static: any multi-feature group present
+    has_missing: bool = True    # static: any feature with a missing type
 
     def tree_flatten(self):
         children = (self.bins, self.bin_offsets, self.num_bins,
@@ -52,7 +53,7 @@ class DeviceData(NamedTuple):
                     self.is_categorical, self.nan_bins,
                     self.feat_group, self.feat_offset)
         aux = (self.total_bins, self.max_bins, self.has_categorical,
-               self.max_group_bins, self.is_bundled)
+               self.max_group_bins, self.is_bundled, self.has_missing)
         return children, aux
 
     @classmethod
@@ -107,4 +108,5 @@ def to_device(ds: BinnedDataset) -> DeviceData:
         has_categorical=bool(info.is_categorical.any()),
         max_group_bins=max_group_bins,
         is_bundled=is_bundled,
+        has_missing=bool((info.missing_types != 0).any()),
     )

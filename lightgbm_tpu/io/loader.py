@@ -78,7 +78,9 @@ def parse_file(path: str, config: Config
                ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
                           Optional[np.ndarray], List[str], List[int]]:
     """-> (X, label, weight, query, feature_names, categorical_cols)."""
+    orig_path = path
     path = localize(path)          # remote schemes -> temp copy (file_io)
+    is_temp_copy = path != orig_path
     fmt = detect_format(path, config.has_header)
     header_names: Optional[List[str]] = None
     skip = 0
@@ -136,6 +138,11 @@ def parse_file(path: str, config: Config
             cat_orig = _parse_multi_spec(cat_spec, header_names)
             remap = {orig: j for j, orig in enumerate(keep)}
             cat_cols = [remap[c] for c in cat_orig if c in remap]
+    if is_temp_copy:
+        try:
+            os.unlink(path)             # free the localized copy now
+        except OSError:
+            pass
     return X, label, weight_inline, query_inline, feature_names, cat_cols
 
 
@@ -169,10 +176,13 @@ def _parse_libsvm(path: str, skip: int) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def _load_side_file(path: str, dtype=np.float32) -> Optional[np.ndarray]:
-    from ..utils.file_io import exists as io_exists
-    if io_exists(path):
-        return np.loadtxt(localize(path), dtype=dtype).reshape(-1)
-    return None
+    try:
+        local = localize(path)          # one remote round-trip, not two
+    except (OSError, IOError):
+        return None
+    if not os.path.exists(local):
+        return None
+    return np.loadtxt(local, dtype=dtype).reshape(-1)
 
 
 def load_file(path: str, config: Config,
@@ -188,7 +198,7 @@ def load_file(path: str, config: Config,
     row shard, mappers allgathered so every rank bins identically
     (`dataset_loader.cpp:816-880`; see ``io/distributed.py``)."""
     bin_path = path + ".bin.npz"
-    is_local = "://" not in path.split("/")[0]
+    is_local = "://" not in path
     # the cache stores whatever one process binned — single-machine,
     # local-FS only (a shard cache would hand other ranks the wrong rows,
     # and all ranks would race-write the same file)

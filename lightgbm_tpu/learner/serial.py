@@ -333,7 +333,8 @@ def rescan_changed(data: DeviceData, params: GrowthParams, feature_mask,
                            data.num_bins, data.missing_types,
                            data.default_bins, data.is_categorical,
                            params.split, feature_mask,
-                           any_categorical=data.has_categorical)
+                           any_categorical=data.has_categorical,
+                           any_missing=data.has_missing)
     return hist_state, ids, res
 
 

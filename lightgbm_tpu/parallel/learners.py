@@ -258,10 +258,10 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
     if feature_mask is None:
         feature_mask = jnp.ones(data.num_features, bool)
 
-    # static fields (total_bins/max_bins/...) are closed over; only arrays
-    # cross the shard_map boundary
-    statics = (data.total_bins, data.max_bins, data.has_categorical,
-               data.max_group_bins, data.is_bundled)
+    # static fields are closed over; only arrays cross the shard_map
+    # boundary.  Derived from the pytree aux so new static fields can't
+    # silently drift out of sync with DeviceData
+    statics = data.tree_flatten()[1]
 
     def step(bins, offs, nb, db, mt, ic, nanb, fg, fo, grad_l, hess_l,
              bag_l, fmask_l):
