@@ -1,0 +1,252 @@
+// C API for lightgbm_tpu — the reference's LGBM_* surface over an
+// embedded CPython interpreter.
+//
+// The reference exports 55 C functions from its C++ core
+// (/root/reference/include/LightGBM/c_api.h, src/c_api.cpp).  Our core is
+// a JAX program, so the native boundary inverts: this shim hosts a Python
+// interpreter and forwards each call to lightgbm_tpu.capi_bridge with
+// integer handles and raw buffer addresses.  Covered: the core dataset /
+// booster / train / predict / model-IO workflow with the reference's
+// function names, argument shapes, and 0/-1 return convention
+// (c_api.h:41-760).  LGBM_GetLastError matches c_api.h:38.
+//
+// Environment:
+//   LGBM_TPU_PYHOME  - interpreter prefix (venv) to embed (optional)
+//   LGBM_TPU_PYPATH  - extra sys.path entry for the package (optional)
+//
+// Build (see tests/test_c_api.py):
+//   g++ -O2 -shared -fPIC lightgbm_tpu_c.cpp -o liblightgbm_tpu_c.so \
+//       $(python-config --includes) -L$LIBDIR -lpython3.X
+#include <Python.h>
+
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+
+namespace {
+
+std::mutex g_mutex;
+std::string g_last_error = "";
+PyObject* g_bridge = nullptr;
+
+void set_error_from_python() {
+  PyObject *type = nullptr, *value = nullptr, *tb = nullptr;
+  PyErr_Fetch(&type, &value, &tb);
+  PyErr_NormalizeException(&type, &value, &tb);
+  g_last_error = "unknown python error";
+  if (value != nullptr) {
+    PyObject* s = PyObject_Str(value);
+    if (s != nullptr) {
+      const char* c = PyUnicode_AsUTF8(s);
+      if (c != nullptr) g_last_error = c;
+      Py_DECREF(s);
+    }
+  }
+  Py_XDECREF(type);
+  Py_XDECREF(value);
+  Py_XDECREF(tb);
+}
+
+// Initialize the interpreter + import the bridge once.
+bool ensure_bridge() {
+  if (g_bridge != nullptr) return true;
+  if (!Py_IsInitialized()) {
+    PyConfig config;
+    PyConfig_InitPythonConfig(&config);
+    const char* home = std::getenv("LGBM_TPU_PYHOME");
+    if (home != nullptr) {
+      std::string exe = std::string(home) + "/bin/python";
+      PyConfig_SetBytesString(&config, &config.program_name, exe.c_str());
+    }
+    PyStatus status = Py_InitializeFromConfig(&config);
+    PyConfig_Clear(&config);
+    if (PyStatus_Exception(status)) {
+      g_last_error = "failed to initialize python";
+      return false;
+    }
+    // hand the GIL to the PyGILState system: the init thread holds it
+    // implicitly after Py_InitializeFromConfig, and Ensure/Release pairs
+    // on that hidden thread state corrupt the interpreter
+    PyEval_SaveThread();
+  }
+  PyGILState_STATE gil = PyGILState_Ensure();
+  const char* extra = std::getenv("LGBM_TPU_PYPATH");
+  if (extra != nullptr) {
+    PyObject* sys_path = PySys_GetObject("path");  // borrowed
+    PyObject* p = PyUnicode_FromString(extra);
+    if (sys_path != nullptr && p != nullptr) PyList_Insert(sys_path, 0, p);
+    Py_XDECREF(p);
+  }
+  g_bridge = PyImport_ImportModule("lightgbm_tpu.capi_bridge");
+  if (g_bridge == nullptr) set_error_from_python();
+  PyGILState_Release(gil);
+  return g_bridge != nullptr;
+}
+
+// Call bridge.<fn>(args...); returns new ref or nullptr (error recorded).
+PyObject* bridge_call(const char* fn, PyObject* args) {
+  PyObject* f = PyObject_GetAttrString(g_bridge, fn);
+  if (f == nullptr) {
+    set_error_from_python();
+    Py_XDECREF(args);
+    return nullptr;
+  }
+  PyObject* out = PyObject_CallObject(f, args);
+  Py_DECREF(f);
+  Py_XDECREF(args);
+  if (out == nullptr) set_error_from_python();
+  return out;
+}
+
+// Run `fn(<args built from format>)`, store the integer result in *out
+// (if non-null).  The argument tuple is built INSIDE the GIL scope —
+// Py_BuildValue before interpreter init would crash.
+int call_int(const char* fn, long long* out, const char* format, ...) {
+  std::lock_guard<std::mutex> lock(g_mutex);
+  if (!ensure_bridge()) return -1;
+  PyGILState_STATE gil = PyGILState_Ensure();
+  va_list va;
+  va_start(va, format);
+  PyObject* args = Py_VaBuildValue(format, va);
+  va_end(va);
+  int rc = -1;
+  if (args == nullptr) {
+    set_error_from_python();
+  } else {
+    PyObject* r = bridge_call(fn, args);
+    if (r != nullptr) {
+      if (out != nullptr) *out = PyLong_AsLongLong(r);
+      rc = (out != nullptr && *out == -1 && PyErr_Occurred()) ? -1 : 0;
+      Py_DECREF(r);
+    }
+  }
+  PyGILState_Release(gil);
+  return rc;
+}
+
+}  // namespace
+
+extern "C" {
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+
+const char* LGBM_GetLastError() { return g_last_error.c_str(); }
+
+int LGBM_DatasetCreateFromMat(const void* data, int data_type,
+                              int32_t nrow, int32_t ncol, int is_row_major,
+                              const char* parameters,
+                              const DatasetHandle reference,
+                              DatasetHandle* out) {
+  if (data_type != 1 /* C_API_DTYPE_FLOAT64 */) {
+    g_last_error = "only float64 matrices are supported";
+    return -1;
+  }
+  long long h = 0;
+  if (call_int("dataset_from_mat", &h, "(LiiisL)", (long long)(intptr_t)data, (int)nrow, (int)ncol, is_row_major, parameters ? parameters : "", (long long)(intptr_t)reference) != 0) return -1;
+  *out = (DatasetHandle)(intptr_t)h;
+  return 0;
+}
+
+int LGBM_DatasetSetField(DatasetHandle handle, const char* field_name,
+                         const void* field_data, int num_element,
+                         int type /* 0=f32, 1=f64 */) {
+  return call_int("dataset_set_field", nullptr, "(LsLii)", (long long)(intptr_t)handle, field_name, (long long)(intptr_t)field_data, num_element, type);
+}
+
+int LGBM_DatasetGetNumData(DatasetHandle handle, int* out) {
+  long long v = 0;
+  if (call_int("dataset_num_data", &v, "(L)", (long long)(intptr_t)handle) != 0)
+    return -1;
+  *out = (int)v;
+  return 0;
+}
+
+int LGBM_DatasetGetNumFeature(DatasetHandle handle, int* out) {
+  long long v = 0;
+  if (call_int("dataset_num_feature", &v, "(L)", (long long)(intptr_t)handle) != 0)
+    return -1;
+  *out = (int)v;
+  return 0;
+}
+
+int LGBM_DatasetFree(DatasetHandle handle) {
+  return call_int("free_handle", nullptr, "(L)", (long long)(intptr_t)handle);
+}
+
+int LGBM_BoosterCreate(const DatasetHandle train_data,
+                       const char* parameters, BoosterHandle* out) {
+  long long h = 0;
+  if (call_int("booster_create", &h, "(Ls)", (long long)(intptr_t)train_data, parameters ? parameters : "") != 0) return -1;
+  *out = (BoosterHandle)(intptr_t)h;
+  return 0;
+}
+
+int LGBM_BoosterCreateFromModelfile(const char* filename, int* out_num_iters,
+                                    BoosterHandle* out) {
+  long long h = 0;
+  if (call_int("booster_create_from_modelfile", &h, "(s)", filename) != 0) return -1;
+  *out = (BoosterHandle)(intptr_t)h;
+  if (out_num_iters != nullptr) {
+    long long it = 0;
+    if (call_int("booster_current_iteration", &it, "(L)", h) != 0) return -1;
+    *out_num_iters = (int)it;
+  }
+  return 0;
+}
+
+int LGBM_BoosterAddValidData(BoosterHandle handle,
+                             const DatasetHandle valid_data) {
+  return call_int("booster_add_valid", nullptr, "(LLs)", (long long)(intptr_t)handle, (long long)(intptr_t)valid_data, "valid");
+}
+
+int LGBM_BoosterUpdateOneIter(BoosterHandle handle, int* is_finished) {
+  long long fin = 0;
+  if (call_int("booster_update_one_iter", &fin, "(L)", (long long)(intptr_t)handle) != 0) return -1;
+  *is_finished = (int)fin;
+  return 0;
+}
+
+int LGBM_BoosterGetNumClasses(BoosterHandle handle, int* out_len) {
+  long long v = 0;
+  if (call_int("booster_num_classes", &v, "(L)", (long long)(intptr_t)handle) != 0)
+    return -1;
+  *out_len = (int)v;
+  return 0;
+}
+
+int LGBM_BoosterGetCurrentIteration(BoosterHandle handle, int* out) {
+  long long v = 0;
+  if (call_int("booster_current_iteration", &v, "(L)", (long long)(intptr_t)handle) != 0)
+    return -1;
+  *out = (int)v;
+  return 0;
+}
+
+int LGBM_BoosterPredictForMat(BoosterHandle handle, const void* data,
+                              int data_type, int32_t nrow, int32_t ncol,
+                              int is_row_major, int predict_type,
+                              int num_iteration, const char* /*parameter*/,
+                              int64_t* out_len, double* out_result) {
+  if (data_type != 1) {
+    g_last_error = "only float64 matrices are supported";
+    return -1;
+  }
+  // predict_type: 0=normal, 1=raw (c_api.h C_API_PREDICT_*)
+  long long n = 0;
+  if (call_int("booster_predict_for_mat", &n, "(LLiiiiiL)", (long long)(intptr_t)handle, (long long)(intptr_t)data, (int)nrow, (int)ncol, is_row_major, predict_type == 1 ? 1 : 0, num_iteration, (long long)(intptr_t)out_result) != 0) return -1;
+  *out_len = (int64_t)n;
+  return 0;
+}
+
+int LGBM_BoosterSaveModel(BoosterHandle handle, int /*start_iteration*/,
+                          int num_iteration, const char* filename) {
+  return call_int("booster_save_model", nullptr, "(Lsi)", (long long)(intptr_t)handle, filename, num_iteration);
+}
+
+int LGBM_BoosterFree(BoosterHandle handle) {
+  return call_int("free_handle", nullptr, "(L)", (long long)(intptr_t)handle);
+}
+
+}  // extern "C"

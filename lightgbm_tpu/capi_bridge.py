@@ -1,0 +1,131 @@
+"""Python side of the C API (handle registry + raw-pointer marshalling).
+
+The reference exposes 55 ``LGBM_*`` functions from C++
+(`/root/reference/src/c_api.cpp`, `include/LightGBM/c_api.h`).  Here the
+native shim (`capi/lightgbm_tpu_c.cpp`) embeds a CPython interpreter and
+calls THIS module with integer handles and raw buffer addresses; all
+object lifetime lives in the registry below.  The C surface keeps the
+reference's names and call shapes for the core train/predict workflow.
+
+Raw pointers arrive as ``int`` addresses and are wrapped zero-copy with
+``ctypes`` + ``np.frombuffer`` — the same marshalling direction as the
+reference's Python package, inverted.
+"""
+from __future__ import annotations
+
+import ctypes
+from typing import Dict
+
+import numpy as np
+
+_handles: Dict[int, object] = {}
+_next = [1]
+
+
+def _put(obj) -> int:
+    h = _next[0]
+    _next[0] += 1
+    _handles[h] = obj
+    return h
+
+
+def _get(h: int):
+    return _handles[int(h)]
+
+
+def free_handle(h: int) -> None:
+    _handles.pop(int(h), None)
+
+
+def _wrap_f64(ptr: int, n: int) -> np.ndarray:
+    buf = (ctypes.c_double * n).from_address(int(ptr))
+    return np.frombuffer(buf, dtype=np.float64, count=n)
+
+
+def _wrap_f32(ptr: int, n: int) -> np.ndarray:
+    buf = (ctypes.c_float * n).from_address(int(ptr))
+    return np.frombuffer(buf, dtype=np.float32, count=n)
+
+
+def _parse_params(params: str) -> dict:
+    out = {}
+    for tok in params.replace("\t", " ").split():
+        if "=" in tok:
+            k, v = tok.split("=", 1)
+            out[k] = v
+    return out
+
+
+# -- datasets (LGBM_DatasetCreateFromMat c_api.h) -------------------------
+def dataset_from_mat(ptr: int, nrow: int, ncol: int, is_row_major: int,
+                     params: str, ref_handle: int) -> int:
+    X = _wrap_f64(ptr, nrow * ncol)
+    X = (X.reshape(nrow, ncol) if is_row_major
+         else X.reshape(ncol, nrow).T).copy()
+    import lightgbm_tpu as lgb
+    ref = _get(ref_handle) if ref_handle else None
+    ds = lgb.Dataset(X, params=_parse_params(params), reference=ref)
+    return _put(ds)
+
+
+def dataset_set_field(h: int, name: str, ptr: int, n: int,
+                      is_float64: int) -> None:
+    arr = _wrap_f64(ptr, n) if is_float64 else _wrap_f32(ptr, n)
+    _get(h).set_field(name, np.array(arr))
+
+
+def dataset_num_data(h: int) -> int:
+    return int(_get(h).num_data())
+
+
+def dataset_num_feature(h: int) -> int:
+    return int(_get(h).num_feature())
+
+
+# -- boosters (LGBM_BoosterCreate / UpdateOneIter / ...) ------------------
+def booster_create(train_handle: int, params: str) -> int:
+    from lightgbm_tpu.basic import Booster
+    return _put(Booster(params=_parse_params(params),
+                        train_set=_get(train_handle)))
+
+
+def booster_create_from_modelfile(path: str) -> int:
+    from lightgbm_tpu.basic import Booster
+    return _put(Booster(model_file=path))
+
+
+def booster_add_valid(h: int, valid_handle: int, name: str) -> None:
+    _get(h).add_valid(_get(valid_handle), name)
+
+
+def booster_update_one_iter(h: int) -> int:
+    return int(bool(_get(h).update()))
+
+
+def booster_num_classes(h: int) -> int:
+    return int(max(1, _get(h)._gbdt.num_class))
+
+
+def booster_current_iteration(h: int) -> int:
+    return int(_get(h).current_iteration)
+
+
+def booster_predict_for_mat(h: int, ptr: int, nrow: int, ncol: int,
+                            is_row_major: int, raw_score: int,
+                            num_iteration: int, out_ptr: int) -> int:
+    X = _wrap_f64(ptr, nrow * ncol)
+    X = (X.reshape(nrow, ncol) if is_row_major
+         else X.reshape(ncol, nrow).T).copy()
+    pred = _get(h).predict(X, raw_score=bool(raw_score),
+                           num_iteration=num_iteration)
+    pred = np.ascontiguousarray(pred, dtype=np.float64).reshape(-1)
+    ctypes.memmove(int(out_ptr), pred.ctypes.data, pred.nbytes)
+    return int(pred.size)
+
+
+def booster_save_model(h: int, path: str, num_iteration: int) -> None:
+    _get(h).save_model(path, num_iteration=num_iteration)
+
+
+def booster_model_to_string(h: int) -> str:
+    return _get(h).model_to_string()

@@ -78,9 +78,7 @@ def parse_file(path: str, config: Config
                ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
                           Optional[np.ndarray], List[str], List[int]]:
     """-> (X, label, weight, query, feature_names, categorical_cols)."""
-    orig_path = path
     path = localize(path)          # remote schemes -> temp copy (file_io)
-    is_temp_copy = path != orig_path
     fmt = detect_format(path, config.has_header)
     header_names: Optional[List[str]] = None
     skip = 0
@@ -138,11 +136,8 @@ def parse_file(path: str, config: Config
             cat_orig = _parse_multi_spec(cat_spec, header_names)
             remap = {orig: j for j, orig in enumerate(keep)}
             cat_cols = [remap[c] for c in cat_orig if c in remap]
-    if is_temp_copy:
-        try:
-            os.unlink(path)             # free the localized copy now
-        except OSError:
-            pass
+    from ..utils.file_io import release
+    release(path)                       # free the localized copy now
     return X, label, weight_inline, query_inline, feature_names, cat_cols
 
 
@@ -176,13 +171,17 @@ def _parse_libsvm(path: str, skip: int) -> Tuple[np.ndarray, np.ndarray]:
 
 
 def _load_side_file(path: str, dtype=np.float32) -> Optional[np.ndarray]:
+    from ..utils.file_io import release
     try:
         local = localize(path)          # one remote round-trip, not two
-    except (OSError, IOError):
-        return None
+    except FileNotFoundError:
+        return None                     # absent side file — not an error
     if not os.path.exists(local):
         return None
-    return np.loadtxt(local, dtype=dtype).reshape(-1)
+    try:
+        return np.loadtxt(local, dtype=dtype).reshape(-1)
+    finally:
+        release(local)
 
 
 def load_file(path: str, config: Config,

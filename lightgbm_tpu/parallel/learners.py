@@ -118,7 +118,8 @@ def make_feature_parallel_strategy(data: DeviceData, grad, hess,
         best = find_best_splits(grid, lsg[safe], lsh[safe], lc[safe],
                                 nb_loc, mt_loc, db_loc, ic_loc,
                                 params.split, fmask,
-                                any_categorical=data.has_categorical)
+                                any_categorical=data.has_categorical,
+                                any_missing=data.has_missing)
         best = best._replace(feature=(best.feature + start).astype(jnp.int32))
         return hist_state, ids, _sync_global_best(best, axis)
 
@@ -184,7 +185,7 @@ def make_voting_parallel_strategy(data: DeviceData, grad, hess,
         ic = data.is_categorical[sel_feats]
         best = _find_best_per_leaf_features(
             sel_grid, lsg[safe], lsh[safe], lc[safe], nb, mt, db, ic,
-            params.split, data.has_categorical)
+            params.split, data.has_categorical, data.has_missing)
         gfeat = jnp.take_along_axis(sel_feats, best.feature[:, None],
                                     axis=1)[:, 0]
         return hist_state, ids, best._replace(
@@ -221,13 +222,15 @@ def _per_feature_gains(grid, lsg, lsh, lc, data: DeviceData,
 
 
 def _find_best_per_leaf_features(sel_grid, lsg, lsh, lc, nb, mt, db, ic,
-                                 sp: SplitParams, any_cat: bool):
+                                 sp: SplitParams, any_cat: bool,
+                                 any_missing: bool = True):
     """find_best_splits variant where each leaf has its OWN feature set
     (per-leaf gathered columns): vmap the single-leaf scan over leaves."""
     def one_leaf(grid_l, sg, sh, cc, nb_l, mt_l, db_l, ic_l):
         r = find_best_splits(grid_l[None], sg[None], sh[None], cc[None],
                              nb_l, mt_l, db_l, ic_l, sp, None,
-                             any_categorical=any_cat)
+                             any_categorical=any_cat,
+                             any_missing=any_missing)
         return jax.tree.map(lambda a: a[0], r)
     return jax.vmap(one_leaf)(sel_grid, lsg, lsh, lc, nb, mt, db, ic)
 

@@ -81,6 +81,17 @@ def exists(path: str) -> bool:
     return os.path.exists(path)
 
 
+def release(path: str) -> None:
+    """Free a temp copy produced by :func:`localize` (no-op for paths it
+    doesn't own) — keeps the temp lifecycle in this module."""
+    if path in _TEMPS:
+        _TEMPS.remove(path)
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+
+
 def localize(path: str) -> str:
     """Return a real OS path for ``path``: identity for local files,
     a temp-file copy for registered remote schemes (per-rank shard

@@ -1,0 +1,159 @@
+"""C API (LGBM_* surface) — compile the embedded-interpreter shim and
+drive it from a real C program.
+
+Reference: `include/LightGBM/c_api.h` / `src/c_api.cpp` and the raw-ctypes
+driving test `tests/c_api_test/test_.py`.
+"""
+import os
+import shutil
+import subprocess
+import sys
+import sysconfig
+import tempfile
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.skipif(shutil.which("g++") is None,
+                                reason="no g++ in environment")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+DRIVER = r"""
+#include <stdio.h>
+#include <stdlib.h>
+#include <stdint.h>
+
+typedef void* DatasetHandle;
+typedef void* BoosterHandle;
+#ifdef __cplusplus
+extern "C" {
+#endif
+extern const char* LGBM_GetLastError();
+extern int LGBM_DatasetCreateFromMat(const void*, int, int32_t, int32_t,
+                                     int, const char*, DatasetHandle,
+                                     DatasetHandle*);
+extern int LGBM_DatasetSetField(DatasetHandle, const char*, const void*,
+                                int, int);
+extern int LGBM_DatasetGetNumData(DatasetHandle, int*);
+extern int LGBM_DatasetGetNumFeature(DatasetHandle, int*);
+extern int LGBM_BoosterCreate(DatasetHandle, const char*, BoosterHandle*);
+extern int LGBM_BoosterUpdateOneIter(BoosterHandle, int*);
+extern int LGBM_BoosterGetCurrentIteration(BoosterHandle, int*);
+extern int LGBM_BoosterPredictForMat(BoosterHandle, const void*, int,
+                                     int32_t, int32_t, int, int, int,
+                                     const char*, int64_t*, double*);
+extern int LGBM_BoosterSaveModel(BoosterHandle, int, int, const char*);
+extern int LGBM_BoosterCreateFromModelfile(const char*, int*, BoosterHandle*);
+extern int LGBM_BoosterFree(BoosterHandle);
+extern int LGBM_DatasetFree(DatasetHandle);
+#ifdef __cplusplus
+}
+#endif
+
+#define CHECK(x) do { if ((x) != 0) { \
+    fprintf(stderr, "FAIL %s: %s\n", #x, LGBM_GetLastError()); return 1; \
+  } } while (0)
+
+int main(int argc, char** argv) {
+  const int n = 600, f = 4;
+  double* X = (double*)malloc(sizeof(double) * n * f);
+  float* y = (float*)malloc(sizeof(float) * n);
+  unsigned s = 12345;
+  for (int i = 0; i < n; ++i) {
+    double row0 = 0;
+    for (int j = 0; j < f; ++j) {
+      s = s * 1103515245u + 12345u;
+      double v = ((double)(s >> 8) / (1u << 24)) * 2.0 - 1.0;
+      X[i * f + j] = v;
+      if (j == 0) row0 = v;
+    }
+    y[i] = row0 > 0.0 ? 1.0f : 0.0f;
+  }
+
+  DatasetHandle ds = NULL;
+  CHECK(LGBM_DatasetCreateFromMat(X, 1, n, f, 1, "max_bin=31", NULL, &ds));
+  CHECK(LGBM_DatasetSetField(ds, "label", y, n, 0));
+  int nd = 0, nf = 0;
+  CHECK(LGBM_DatasetGetNumData(ds, &nd));
+  CHECK(LGBM_DatasetGetNumFeature(ds, &nf));
+  printf("num_data=%d num_feature=%d\n", nd, nf);
+
+  BoosterHandle bst = NULL;
+  CHECK(LGBM_BoosterCreate(ds,
+        "objective=binary num_leaves=7 verbose=-1", &bst));
+  int fin = 0;
+  for (int it = 0; it < 5; ++it) CHECK(LGBM_BoosterUpdateOneIter(bst, &fin));
+  int cur = 0;
+  CHECK(LGBM_BoosterGetCurrentIteration(bst, &cur));
+  printf("iterations=%d\n", cur);
+
+  int64_t out_len = 0;
+  double* pred = (double*)malloc(sizeof(double) * n);
+  CHECK(LGBM_BoosterPredictForMat(bst, X, 1, n, f, 1, 0, -1, "",
+                                  &out_len, pred));
+  int correct = 0;
+  for (int i = 0; i < n; ++i)
+    if ((pred[i] > 0.5) == (y[i] > 0.5f)) ++correct;
+  printf("out_len=%lld acc=%.4f\n", (long long)out_len,
+         (double)correct / n);
+
+  CHECK(LGBM_BoosterSaveModel(bst, 0, -1, argv[1]));
+  BoosterHandle bst2 = NULL;
+  int iters2 = 0;
+  CHECK(LGBM_BoosterCreateFromModelfile(argv[1], &iters2, &bst2));
+  double* pred2 = (double*)malloc(sizeof(double) * n);
+  CHECK(LGBM_BoosterPredictForMat(bst2, X, 1, n, f, 1, 0, -1, "",
+                                  &out_len, pred2));
+  double maxdiff = 0;
+  for (int i = 0; i < n; ++i) {
+    double d = pred[i] - pred2[i];
+    if (d < 0) d = -d;
+    if (d > maxdiff) maxdiff = d;
+  }
+  printf("reload_iters=%d maxdiff=%.8f\n", iters2, maxdiff);
+
+  CHECK(LGBM_BoosterFree(bst2));
+  CHECK(LGBM_BoosterFree(bst));
+  CHECK(LGBM_DatasetFree(ds));
+  printf("C_API_OK\n");
+  return 0;
+}
+"""
+
+
+def test_c_api_end_to_end(tmp_path):
+    inc = sysconfig.get_path("include")
+    libdir = sysconfig.get_config_var("LIBDIR")
+    pyver = f"python{sys.version_info.major}.{sys.version_info.minor}"
+    shim = tmp_path / "liblightgbm_tpu_c.so"
+    subprocess.check_call(
+        ["g++", "-O2", "-shared", "-fPIC",
+         os.path.join(REPO, "lightgbm_tpu", "capi", "lightgbm_tpu_c.cpp"),
+         "-o", str(shim), f"-I{inc}", f"-L{libdir}", f"-l{pyver}"])
+    driver_src = tmp_path / "driver.c"
+    driver_src.write_text(DRIVER)
+    driver = tmp_path / "driver"
+    subprocess.check_call(
+        ["g++", "-O2", str(driver_src), "-o", str(driver),
+         str(shim), f"-L{libdir}", f"-l{pyver}",
+         f"-Wl,-rpath,{libdir}", f"-Wl,-rpath,{tmp_path}"])
+
+    env = dict(os.environ)
+    env["LGBM_TPU_PYPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    prefix = os.path.dirname(os.path.dirname(sys.executable))
+    if os.path.exists(os.path.join(prefix, "pyvenv.cfg")):
+        env["LGBM_TPU_PYHOME"] = prefix
+    model_path = tmp_path / "model.txt"
+    out = subprocess.run([str(driver), str(model_path)], env=env,
+                         capture_output=True, text=True, timeout=500)
+    assert out.returncode == 0, (out.stdout[-800:], out.stderr[-800:])
+    assert "C_API_OK" in out.stdout
+    lines = dict(kv.split("=", 1) for ln in out.stdout.splitlines()
+                 for kv in ln.split() if "=" in kv)
+    assert lines["num_data"] == "600" and lines["num_feature"] == "4"
+    assert lines["iterations"] == "5"
+    assert float(lines["acc"]) > 0.9
+    assert float(lines["maxdiff"]) < 1e-5
+    assert model_path.exists()
