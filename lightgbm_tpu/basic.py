@@ -265,8 +265,9 @@ class Booster:
 
     # -- evaluation -----------------------------------------------------
     def eval_train(self, feval=None):
-        return self._format_eval(self._gbdt.eval_train(), feval, "training",
-                                 self._train_dataset)
+        name = getattr(self, "_train_data_name", "training")
+        results = [(name, m, v, h) for _, m, v, h in self._gbdt.eval_train()]
+        return self._format_eval(results, feval, name, self._train_dataset)
 
     def eval_valid(self, feval=None):
         out = self._format_eval(self._gbdt.eval_valid(), feval, None, None)

@@ -102,7 +102,8 @@ def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
                 best_score[i] = val
                 best_iter[i] = env.iteration
                 best_score_list[i] = env.evaluation_result_list
-            if name == "training":
+            train_name = getattr(env.model, "_train_data_name", "training")
+            if name in ("training", train_name):
                 continue        # train metric never triggers stopping
             if env.iteration - best_iter[i] >= stopping_rounds:
                 if verbose:

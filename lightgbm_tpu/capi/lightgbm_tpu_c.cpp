@@ -116,8 +116,16 @@ int call_int(const char* fn, long long* out, const char* format, ...) {
   } else {
     PyObject* r = bridge_call(fn, args);
     if (r != nullptr) {
-      if (out != nullptr) *out = PyLong_AsLongLong(r);
-      rc = (out != nullptr && *out == -1 && PyErr_Occurred()) ? -1 : 0;
+      rc = 0;
+      if (out != nullptr) {
+        *out = PyLong_AsLongLong(r);
+        if (*out == -1 && PyErr_Occurred()) {
+          // record AND clear the pending exception: leaving the error
+          // indicator set would poison the next CPython call
+          set_error_from_python();
+          rc = -1;
+        }
+      }
       Py_DECREF(r);
     }
   }
@@ -187,12 +195,16 @@ int LGBM_BoosterCreateFromModelfile(const char* filename, int* out_num_iters,
                                     BoosterHandle* out) {
   long long h = 0;
   if (call_int("booster_create_from_modelfile", &h, "(s)", filename) != 0) return -1;
-  *out = (BoosterHandle)(intptr_t)h;
   if (out_num_iters != nullptr) {
     long long it = 0;
-    if (call_int("booster_current_iteration", &it, "(L)", h) != 0) return -1;
+    if (call_int("booster_current_iteration", &it, "(L)", h) != 0) {
+      // don't leak the booster on the partial-failure path
+      call_int("free_handle", nullptr, "(L)", h);
+      return -1;
+    }
     *out_num_iters = (int)it;
   }
+  *out = (BoosterHandle)(intptr_t)h;
   return 0;
 }
 

@@ -150,7 +150,7 @@ PARAMETER_SET = frozenset({
     "name_node", "username",
     # TPU-native extensions
     "mesh_shape", "data_axis_name", "feature_axis_name", "hist_dtype",
-    "growth_mode", "deterministic",
+    "growth_mode", "deterministic", "hist_mode",
     # commonly passed by the python layer
     "categorical_feature", "feature_name", "objective_seed", "metric_seed",
 })
@@ -305,6 +305,13 @@ class Config:
     min_data_per_group: int = 100
     histogram_pool_size: float = -1.0
     growth_mode: str = "wave"                # wave (TPU fast) | leafwise (reference-exact)
+    # histogram accumulation precision on the Pallas path (the TPU analog
+    # of the reference's gpu_use_dp, docs/GPU-Performance.rst:135-161):
+    # "" = auto (bf16 products, f32 accumulation; see
+    # learner/serial.py default_hist_mode + the recorded parity table),
+    # "bf16" | "hilo" (hi+lo bf16 pairs, ~f32 sums) | "scatter" is
+    # accepted via hist_backend-style env override for debugging.
+    hist_mode: str = ""
 
     # --- io / dataset -------------------------------------------------------
     max_bin: int = 255
@@ -430,6 +437,14 @@ class Config:
             raise ValueError(f"unknown tree_learner {self.tree_learner!r}")
         if self.growth_mode not in ("wave", "leafwise"):
             raise ValueError(f"unknown growth_mode {self.growth_mode!r}")
+        if self.hist_mode not in ("", "bf16", "hilo"):
+            raise ValueError(f"unknown hist_mode {self.hist_mode!r}")
+        # gpu_use_dp is the reference's GPU double-precision knob
+        # (docs/GPU-Performance.rst): honor it as "use the high-precision
+        # histogram mode" unless hist_mode was given explicitly
+        if not self.hist_mode and self.extra.get("gpu_use_dp") in (
+                True, "true", "1", 1):
+            self.hist_mode = "hilo"
         # accepted-but-inert knobs must warn loudly, not silently no-op
         # (reference knobs that have no TPU counterpart)
         from .utils.log import log_warning
@@ -437,10 +452,10 @@ class Config:
             log_warning("use_two_round_loading has no effect: the TPU "
                         "loader streams once into the HBM binned matrix")
         if self.extra.get("gpu_platform_id") is not None or \
-                self.extra.get("gpu_device_id") is not None or \
-                self.extra.get("gpu_use_dp") is not None:
-            log_warning("gpu_* parameters have no effect: device selection "
-                        "is JAX's (TPU kernels replace the OpenCL learner)")
+                self.extra.get("gpu_device_id") is not None:
+            log_warning("gpu_platform_id/gpu_device_id have no effect: "
+                        "device selection is JAX's (TPU kernels replace "
+                        "the OpenCL learner)")
 
     @property
     def is_parallel(self) -> bool:

@@ -165,9 +165,13 @@ def default_hist_mode() -> str:
     """bf16 by default: ~2^-8 relative histogram error (counts stay
     exact; the MXU accumulates in f32) for 3/5 the MXU work — the
     reference's own GPU posture, which defaults to single precision
-    (`docs/GPU-Performance.rst:135-161`, ``gpu_use_dp=false``).  Bench
-    AUC is identical to the hi+lo mode at 20 and 60 iterations;
-    LGBM_TPU_HIST_MODE=hilo restores ~f32 sums via hi+lo bf16 pairs."""
+    (`docs/GPU-Performance.rst:135-161`, ``gpu_use_dp=false``).
+    Validated at reference depth: the recorded 500-iteration parity
+    table (`tests/test_hist_parity.py`) shows bf16 vs hi+lo vs scatter
+    AUC agreement within the reference's GPU-parity tolerances.
+    Overrides: the ``hist_mode`` config parameter (or ``gpu_use_dp``,
+    which maps to hilo) wins; the LGBM_TPU_HIST_MODE env var is the
+    debug-level override below it."""
     import os
     return os.environ.get("LGBM_TPU_HIST_MODE", "bf16")
 
@@ -359,16 +363,97 @@ def build_tree(data: DeviceData,
     absent)."""
     n = data.bins.shape[0]
     L = params.num_leaves
-    Lm = max(L - 1, 1)
-    B = bin_stride(data.max_bins)                  # feature-space stride
-    Bh = bin_stride(data.group_max_bins)           # stored-column stride
-    Gh = (num_hist_features if num_hist_features is not None
-          else data.num_groups)
 
     mode = hist_mode or default_hist_mode()
     backend = resolve_backend(data, L, hist_backend, mode)
     if backend == "pallas" and bins_t is None:
         bins_t = transpose_bins(data.bins)
+
+    # staged waves only pay off on the Pallas path (MXU cost ∝ slots);
+    # the scatter backend compiles one while-loop body instead (8 unrolled
+    # stages × shard_map × 3 learners is minutes of XLA-CPU compile time)
+    if backend == "pallas":
+        plan, A_tail = stage_plan(L, params.wave_size)
+    else:
+        plan, A_tail = [], _round8(max(1, L // 2))
+    wave_cap = params.wave_size if params.wave_size > 0 else L
+    # fused route+hist: one bins stream per wave (serial Pallas path with
+    # every stored column in a single kernel tile)
+    fused = (strategy is None and psum_fn is None and backend == "pallas"
+             and fused_config_ok(bins_t.shape[0], data.group_max_bins, L,
+                                 mode))
+    fused_fn = (make_fused_fn(data, grad, hess, mode, bins_t)
+                if fused else None)
+    if strategy is None and not fused:
+        strategy = make_serial_strategy(data, grad, hess, params,
+                                        feature_mask, psum_fn=psum_fn,
+                                        backend=backend, bins_t=bins_t,
+                                        hist_mode=hist_mode)
+    route_fn = make_route_fn(data, backend, bins_t)
+
+    def scan_changed(hist_state, new_h, s, lsg, lsh, lc):
+        return rescan_changed(data, params, feature_mask, hist_state, new_h,
+                              s.act_small, s.act_parent, s.act_sibling,
+                              lsg, lsh, lc)
+
+    A0 = plan[0] if plan else A_tail
+    state = _init_state(data, grad, hess, params, bag_mask, psum_fn,
+                        backend, bins_t, num_hist_features, A0)
+
+    def body(s: _WaveState, A_out: int) -> _WaveState:
+        # --- 0-3: apply last wave's pending splits to the rows, then
+        # histogram the active leaves, subtract siblings, rescan.  The
+        # fused kernel does the route inside the histogram's bins stream.
+        if fused:
+            new_h, leaf2 = fused_fn(s.leaf2, s.best, s.pend_sel,
+                                    s.pend_new, s.act_small)
+            hist_state, ids, res = scan_changed(
+                s.hist_state, new_h, s, s.leaf_sum_grad, s.leaf_sum_hess,
+                s.leaf_count)
+        else:
+            leaf2 = route_fn(s.leaf2, s.best, s.pend_sel, s.pend_new)
+            hist_state, ids, res = strategy(
+                s.hist_state, leaf2[1], s.act_small, s.act_parent,
+                s.act_sibling, s.leaf_sum_grad, s.leaf_sum_hess,
+                s.leaf_count)
+        return _apply_wave(s, leaf2, hist_state, ids, res, A_out, params,
+                           wave_cap)
+
+    # --- staged unrolled waves (slot counts track the growing tree) -----
+    for i, A_in in enumerate(plan):
+        A_out = plan[i + 1] if i + 1 < len(plan) else A_tail
+        state = body(state, A_out)
+
+    # --- while-loop tail at fixed slot count -----------------------------
+    def cond(s: _WaveState):
+        return (~s.done) & (s.nl < L)
+
+    final = jax.lax.while_loop(cond, lambda s: body(s, A_tail), state)
+    # apply the last wave's pending splits before reading row_leaf
+    leaf2_final = route_fn(final.leaf2, final.best, final.pend_sel,
+                           final.pend_new)
+    final = final._replace(leaf2=leaf2_final)
+    return final.tree._replace(
+        leaf_value=final.leaf_value,
+        leaf_count=final.leaf_count.astype(jnp.int32),
+        leaf_depth=final.leaf_depth,
+        num_leaves=final.nl,
+        row_leaf=final.leaf2[0, :n],
+    )
+
+
+def _init_state(data: DeviceData, grad, hess, params: GrowthParams,
+                bag_mask, psum_fn, backend: str, bins_t,
+                num_hist_features: Optional[int], A0: int) -> _WaveState:
+    """Initial wave state: empty tree, root leaf stats, root wave active
+    set.  Shared by :func:`build_tree` and :func:`build_tree_phases`."""
+    n = data.bins.shape[0]
+    L = params.num_leaves
+    Lm = max(L - 1, 1)
+    B = bin_stride(data.max_bins)                  # feature-space stride
+    Bh = bin_stride(data.group_max_bins)           # stored-column stride
+    Gh = (num_hist_features if num_hist_features is not None
+          else data.num_groups)
     n_pad = bins_t.shape[1] if backend == "pallas" else n
 
     row_leaf0 = jnp.zeros(n, jnp.int32)
@@ -408,35 +493,7 @@ def build_tree(data: DeviceData,
     root_out = _leaf_out(sum_g, sum_h, params.split.lambda_l1,
                          params.split.lambda_l2)
 
-    # staged waves only pay off on the Pallas path (MXU cost ∝ slots);
-    # the scatter backend compiles one while-loop body instead (8 unrolled
-    # stages × shard_map × 3 learners is minutes of XLA-CPU compile time)
-    if backend == "pallas":
-        plan, A_tail = stage_plan(L, params.wave_size)
-    else:
-        plan, A_tail = [], _round8(max(1, L // 2))
-    wave_cap = params.wave_size if params.wave_size > 0 else L
-    # fused route+hist: one bins stream per wave (serial Pallas path with
-    # every stored column in a single kernel tile)
-    fused = (strategy is None and psum_fn is None and backend == "pallas"
-             and fused_config_ok(bins_t.shape[0], data.group_max_bins, L,
-                                 mode))
-    fused_fn = (make_fused_fn(data, grad, hess, mode, bins_t)
-                if fused else None)
-    if strategy is None and not fused:
-        strategy = make_serial_strategy(data, grad, hess, params,
-                                        feature_mask, psum_fn=psum_fn,
-                                        backend=backend, bins_t=bins_t,
-                                        hist_mode=hist_mode)
-    route_fn = make_route_fn(data, backend, bins_t)
-
-    def scan_changed(hist_state, new_h, s, lsg, lsh, lc):
-        return rescan_changed(data, params, feature_mask, hist_state, new_h,
-                              s.act_small, s.act_parent, s.act_sibling,
-                              lsg, lsh, lc)
-
-    A0 = plan[0] if plan else A_tail
-    state = _WaveState(
+    return _WaveState(
         leaf2=leaf2,
         nl=jnp.asarray(1, jnp.int32), done=jnp.asarray(False),
         leaf_sum_grad=jnp.zeros(L).at[0].set(sum_g),
@@ -456,143 +513,205 @@ def build_tree(data: DeviceData,
         tree=tree,
     )
 
-    def body(s: _WaveState, A_out: int) -> _WaveState:
-        # --- 0-3: apply last wave's pending splits to the rows, then
-        # histogram the active leaves, subtract siblings, rescan.  The
-        # fused kernel does the route inside the histogram's bins stream.
-        if fused:
-            new_h, leaf2 = fused_fn(s.leaf2, s.best, s.pend_sel,
-                                    s.pend_new, s.act_small)
-            hist_state, ids, res = scan_changed(
-                s.hist_state, new_h, s, s.leaf_sum_grad, s.leaf_sum_hess,
-                s.leaf_count)
-        else:
-            leaf2 = route_fn(s.leaf2, s.best, s.pend_sel, s.pend_new)
-            hist_state, ids, res = strategy(
-                s.hist_state, leaf2[1], s.act_small, s.act_parent,
-                s.act_sibling, s.leaf_sum_grad, s.leaf_sum_hess,
-                s.leaf_count)
-        best = jax.tree.map(
-            lambda cur, new: cur.at[
-                jnp.where(ids >= 0, ids, L)].set(new, mode="drop"),
-            s.best, res)
 
-        # --- 4: select this wave's splits -------------------------------
-        lid = jnp.arange(L)
-        gain = jnp.where(lid < s.nl, best.gain, NEG_INF)
-        if params.max_depth > 0:
-            gain = jnp.where(s.leaf_depth >= params.max_depth, NEG_INF, gain)
-        can = gain > 0.0
+def make_phases_driver(data: DeviceData,
+                       params: GrowthParams,
+                       hist_backend: str = "auto",
+                       bins_t: Optional[jnp.ndarray] = None,
+                       hist_mode: Optional[str] = None):
+    """Once-per-booster factory for the per-phase-timed UNFUSED wave
+    driver (``LGBM_TPU_TIMETAG=phases``).
 
-        order = jnp.argsort(-gain)                      # leaves by gain desc
-        rank = jnp.argsort(order)                       # rank[l]
-        budget = L - s.nl
-        k = jnp.minimum(jnp.minimum(jnp.sum(can), budget),
-                        min(wave_cap, A_out))
-        sel = can & (rank < k)
+    Returns ``build(grad, hess, bag_mask=None, feature_mask=None) ->
+    BuiltTree`` running the same wave algorithm as :func:`build_tree`
+    but with route / hist / scan / update as SEPARATE device dispatches,
+    each wrapped in a timetag — the analog of the reference's per-phase
+    TIMETAG counters (`serial_tree_learner.cpp:12-39`), which a single
+    fused jitted scan cannot attribute.  The jitted phase functions are
+    built HERE, once, with grad/hess as traced arguments, so repeated
+    trees reuse the compiled programs and the tags time kernels, not
+    compiles.  Every dispatch still pays the host-device round trip
+    (tens of ms through a remote-device tunnel), so read the REPORT'S
+    RATIOS, not its sums, and never compare its totals to the fused
+    path's wall clock.  Must be called OUTSIDE jit."""
+    from ..utils.timetag import tag
+    n = data.bins.shape[0]
+    L = params.num_leaves
+    mode = hist_mode or default_hist_mode()
+    backend = resolve_backend(data, L, hist_backend, mode)
+    if backend == "pallas" and bins_t is None:
+        bins_t = jax.jit(transpose_bins)(data.bins)
+    _, A_tail = stage_plan(L, params.wave_size)
+    wave_cap = params.wave_size if params.wave_size > 0 else L
 
-        new_id = jnp.where(sel, s.nl + rank, L)         # L => drop scatter
-        node_idx = jnp.where(sel, s.nl - 1 + rank, Lm)  # Lm => drop scatter
+    route_fn = make_route_fn(data, backend, bins_t)
 
-        # --- 5: record tree nodes (scatter at node_idx; drop unselected)
-        t = s.tree
-        dl = jnp.where(best.is_categorical, False, best.default_left)
-        t = t._replace(
-            feature=t.feature.at[node_idx].set(best.feature, mode="drop"),
-            threshold_bin=t.threshold_bin.at[node_idx].set(best.threshold,
-                                                           mode="drop"),
-            default_left=t.default_left.at[node_idx].set(dl, mode="drop"),
-            is_categorical=t.is_categorical.at[node_idx].set(
-                best.is_categorical, mode="drop"),
-            cat_mask=t.cat_mask.at[node_idx].set(best.cat_mask, mode="drop"),
-            gain=t.gain.at[node_idx].set(best.gain, mode="drop"),
-            internal_value=t.internal_value.at[node_idx].set(
-                s.leaf_value, mode="drop"),
-            internal_count=t.internal_count.at[node_idx].set(
-                s.leaf_count.astype(jnp.int32), mode="drop"),
-            left_child=t.left_child.at[node_idx].set(~lid, mode="drop"),
-            right_child=t.right_child.at[node_idx].set(
-                ~new_id, mode="drop"),
+    @jax.jit
+    def init_jit(grad, hess, bag_mask):
+        return _init_state(data, grad, hess, params, bag_mask, None,
+                           backend, bins_t, None, A_tail)
+
+    @jax.jit
+    def hist_jit(grad, hess, s):
+        hist_fn = make_hist_fn(data, grad, hess, L, backend, mode, bins_t)
+        return hist_fn(s.leaf2[1], s.act_small)
+
+    @jax.jit
+    def scan_jit(s, new_h, feature_mask):
+        return rescan_changed(
+            data, params, feature_mask, s.hist_state, new_h, s.act_small,
+            s.act_parent, s.act_sibling, s.leaf_sum_grad, s.leaf_sum_hess,
+            s.leaf_count)
+
+    @jax.jit
+    def route_jit(s):
+        return route_fn(s.leaf2, s.best, s.pend_sel, s.pend_new)
+
+    update_jit = jax.jit(functools.partial(
+        _apply_wave, A_out=A_tail, params=params, wave_cap=wave_cap))
+
+    def build(grad, hess, bag_mask=None, feature_mask=None) -> BuiltTree:
+        state = init_jit(grad, hess, bag_mask)
+        while True:
+            with tag("tree:route") as done:
+                leaf2 = route_jit(state)
+                done(leaf2)
+            state = state._replace(leaf2=leaf2)
+            with tag("tree:hist") as done:
+                new_h = hist_jit(grad, hess, state)
+                done(new_h)
+            with tag("tree:scan") as done:
+                hist_state, ids, res = scan_jit(state, new_h, feature_mask)
+                done(res.gain)
+            with tag("tree:update") as done:
+                state = update_jit(state, leaf2, hist_state, ids, res)
+                done(state.nl)
+            if bool(state.done) or int(state.nl) >= L:
+                break
+        with tag("tree:route") as done:
+            leaf2 = route_jit(state)
+            done(leaf2)
+        state = state._replace(leaf2=leaf2)
+        return state.tree._replace(
+            leaf_value=state.leaf_value,
+            leaf_count=state.leaf_count.astype(jnp.int32),
+            leaf_depth=state.leaf_depth,
+            num_leaves=state.nl,
+            row_leaf=state.leaf2[0, :n],
         )
-        # fix the parent's child pointer: leaf l was ~l, becomes node_idx
-        parent = jnp.where(sel, s.leaf_parent, -1)
-        fix_left = jnp.where(sel & s.leaf_is_left & (parent >= 0),
-                             parent, Lm)
-        fix_right = jnp.where(sel & ~s.leaf_is_left & (parent >= 0),
-                              parent, Lm)
-        t = t._replace(
-            left_child=t.left_child.at[fix_left].set(node_idx, mode="drop"),
-            right_child=t.right_child.at[fix_right].set(node_idx, mode="drop"),
-        )
 
-        # --- 6: update leaf state: left child keeps id l, right -> new_id
-        depth1 = s.leaf_depth + 1
-        lsg = jnp.where(sel, best.left_sum_grad, s.leaf_sum_grad)
-        lsh = jnp.where(sel, best.left_sum_hess, s.leaf_sum_hess)
-        lc = jnp.where(sel, best.left_count, s.leaf_count)
-        lv = jnp.where(sel, best.left_output, s.leaf_value)
-        ld = jnp.where(sel, depth1, s.leaf_depth)
-        lp = jnp.where(sel, node_idx, s.leaf_parent)
-        lil = jnp.where(sel, True, s.leaf_is_left)
+    return build
 
-        lsg = lsg.at[new_id].set(best.right_sum_grad, mode="drop")
-        lsh = lsh.at[new_id].set(best.right_sum_hess, mode="drop")
-        lc = lc.at[new_id].set(best.right_count, mode="drop")
-        lv = lv.at[new_id].set(best.right_output, mode="drop")
-        ld = ld.at[new_id].set(depth1, mode="drop")
-        lp = lp.at[new_id].set(node_idx, mode="drop")
-        lil = lil.at[new_id].set(False, mode="drop")
 
-        # --- 7: this wave's splits become the pending route, applied at
-        # the start of the next wave (or post-loop finalization)
-        pend_sel = sel
-        pend_new = jnp.where(sel, new_id, 0).astype(jnp.int32)
+def _apply_wave(s: _WaveState, leaf2, hist_state, ids, res: SplitResult,
+                A_out: int, params: GrowthParams,
+                wave_cap: int) -> _WaveState:
+    """Post-histogram wave bookkeeping: merge rescanned best splits,
+    select this wave's splits by gain rank, record tree nodes, update
+    leaf state, and stage the next wave's active sets.  Shared between
+    the jitted wave body and the phase-timed debug driver
+    (:func:`build_tree_phases`)."""
+    L = s.leaf_sum_grad.shape[0]
+    Lm = s.tree.feature.shape[0]
+    best = jax.tree.map(
+        lambda cur, new: cur.at[
+            jnp.where(ids >= 0, ids, L)].set(new, mode="drop"),
+        s.best, res)
 
-        # --- 8: next wave's active sets (smaller child + subtraction) ---
-        # the smaller child gets histogrammed; the sibling is derived from
-        # the parent histogram left in slot l (the left child's id)
-        smaller_left = best.left_count <= best.right_count
-        small_val = jnp.where(smaller_left, lid, new_id)
-        sib_val = jnp.where(smaller_left, new_id, lid)
-        slot = jnp.where(sel, rank, A_out)
-        pad_out = jnp.full(A_out, -1, jnp.int32)
-        act_small = pad_out.at[slot].set(small_val, mode="drop")
-        act_parent = pad_out.at[slot].set(lid, mode="drop")
-        act_sibling = pad_out.at[slot].set(sib_val, mode="drop")
+    # --- 4: select this wave's splits -------------------------------
+    lid = jnp.arange(L)
+    gain = jnp.where(lid < s.nl, best.gain, NEG_INF)
+    if params.max_depth > 0:
+        gain = jnp.where(s.leaf_depth >= params.max_depth, NEG_INF, gain)
+    can = gain > 0.0
 
-        nl2 = s.nl + k
-        return _WaveState(
-            leaf2=leaf2, nl=nl2,
-            done=(k == 0),
-            leaf_sum_grad=lsg, leaf_sum_hess=lsh, leaf_count=lc,
-            leaf_depth=ld, leaf_value=lv, leaf_parent=lp, leaf_is_left=lil,
-            hist_state=hist_state, best=best,
-            pend_sel=pend_sel, pend_new=pend_new,
-            act_small=act_small, act_parent=act_parent,
-            act_sibling=act_sibling,
-            tree=t)
+    order = jnp.argsort(-gain)                      # leaves by gain desc
+    rank = jnp.argsort(order)                       # rank[l]
+    budget = L - s.nl
+    k = jnp.minimum(jnp.minimum(jnp.sum(can), budget),
+                    min(wave_cap, A_out))
+    sel = can & (rank < k)
 
-    # --- staged unrolled waves (slot counts track the growing tree) -----
-    for i, A_in in enumerate(plan):
-        A_out = plan[i + 1] if i + 1 < len(plan) else A_tail
-        state = body(state, A_out)
+    new_id = jnp.where(sel, s.nl + rank, L)         # L => drop scatter
+    node_idx = jnp.where(sel, s.nl - 1 + rank, Lm)  # Lm => drop scatter
 
-    # --- while-loop tail at fixed slot count -----------------------------
-    def cond(s: _WaveState):
-        return (~s.done) & (s.nl < L)
-
-    final = jax.lax.while_loop(cond, lambda s: body(s, A_tail), state)
-    # apply the last wave's pending splits before reading row_leaf
-    leaf2_final = route_fn(final.leaf2, final.best, final.pend_sel,
-                           final.pend_new)
-    final = final._replace(leaf2=leaf2_final)
-    return final.tree._replace(
-        leaf_value=final.leaf_value,
-        leaf_count=final.leaf_count.astype(jnp.int32),
-        leaf_depth=final.leaf_depth,
-        num_leaves=final.nl,
-        row_leaf=final.leaf2[0, :n],
+    # --- 5: record tree nodes (scatter at node_idx; drop unselected)
+    t = s.tree
+    dl = jnp.where(best.is_categorical, False, best.default_left)
+    t = t._replace(
+        feature=t.feature.at[node_idx].set(best.feature, mode="drop"),
+        threshold_bin=t.threshold_bin.at[node_idx].set(best.threshold,
+                                                       mode="drop"),
+        default_left=t.default_left.at[node_idx].set(dl, mode="drop"),
+        is_categorical=t.is_categorical.at[node_idx].set(
+            best.is_categorical, mode="drop"),
+        cat_mask=t.cat_mask.at[node_idx].set(best.cat_mask, mode="drop"),
+        gain=t.gain.at[node_idx].set(best.gain, mode="drop"),
+        internal_value=t.internal_value.at[node_idx].set(
+            s.leaf_value, mode="drop"),
+        internal_count=t.internal_count.at[node_idx].set(
+            s.leaf_count.astype(jnp.int32), mode="drop"),
+        left_child=t.left_child.at[node_idx].set(~lid, mode="drop"),
+        right_child=t.right_child.at[node_idx].set(
+            ~new_id, mode="drop"),
     )
+    # fix the parent's child pointer: leaf l was ~l, becomes node_idx
+    parent = jnp.where(sel, s.leaf_parent, -1)
+    fix_left = jnp.where(sel & s.leaf_is_left & (parent >= 0),
+                         parent, Lm)
+    fix_right = jnp.where(sel & ~s.leaf_is_left & (parent >= 0),
+                          parent, Lm)
+    t = t._replace(
+        left_child=t.left_child.at[fix_left].set(node_idx, mode="drop"),
+        right_child=t.right_child.at[fix_right].set(node_idx, mode="drop"),
+    )
+
+    # --- 6: update leaf state: left child keeps id l, right -> new_id
+    depth1 = s.leaf_depth + 1
+    lsg = jnp.where(sel, best.left_sum_grad, s.leaf_sum_grad)
+    lsh = jnp.where(sel, best.left_sum_hess, s.leaf_sum_hess)
+    lc = jnp.where(sel, best.left_count, s.leaf_count)
+    lv = jnp.where(sel, best.left_output, s.leaf_value)
+    ld = jnp.where(sel, depth1, s.leaf_depth)
+    lp = jnp.where(sel, node_idx, s.leaf_parent)
+    lil = jnp.where(sel, True, s.leaf_is_left)
+
+    lsg = lsg.at[new_id].set(best.right_sum_grad, mode="drop")
+    lsh = lsh.at[new_id].set(best.right_sum_hess, mode="drop")
+    lc = lc.at[new_id].set(best.right_count, mode="drop")
+    lv = lv.at[new_id].set(best.right_output, mode="drop")
+    ld = ld.at[new_id].set(depth1, mode="drop")
+    lp = lp.at[new_id].set(node_idx, mode="drop")
+    lil = lil.at[new_id].set(False, mode="drop")
+
+    # --- 7: this wave's splits become the pending route, applied at
+    # the start of the next wave (or post-loop finalization)
+    pend_sel = sel
+    pend_new = jnp.where(sel, new_id, 0).astype(jnp.int32)
+
+    # --- 8: next wave's active sets (smaller child + subtraction) ---
+    # the smaller child gets histogrammed; the sibling is derived from
+    # the parent histogram left in slot l (the left child's id)
+    smaller_left = best.left_count <= best.right_count
+    small_val = jnp.where(smaller_left, lid, new_id)
+    sib_val = jnp.where(smaller_left, new_id, lid)
+    slot = jnp.where(sel, rank, A_out)
+    pad_out = jnp.full(A_out, -1, jnp.int32)
+    act_small = pad_out.at[slot].set(small_val, mode="drop")
+    act_parent = pad_out.at[slot].set(lid, mode="drop")
+    act_sibling = pad_out.at[slot].set(sib_val, mode="drop")
+
+    nl2 = s.nl + k
+    return _WaveState(
+        leaf2=leaf2, nl=nl2,
+        done=(k == 0),
+        leaf_sum_grad=lsg, leaf_sum_hess=lsh, leaf_count=lc,
+        leaf_depth=ld, leaf_value=lv, leaf_parent=lp, leaf_is_left=lil,
+        hist_state=hist_state, best=best,
+        pend_sel=pend_sel, pend_new=pend_new,
+        act_small=act_small, act_parent=act_parent,
+        act_sibling=act_sibling,
+        tree=t)
 
 
 @jax.jit

@@ -15,10 +15,9 @@ For one row-tile of ``T`` rows we build, entirely in VMEM,
   `serial_tree_learner.cpp:358-372`),
 
 and accumulate ``oh @ vw -> [F*B, cols]`` into a VMEM accumulator over the
-row grid.  The one-hot itself is produced by a tiny MXU matmul
-(``spread.T @ bins`` replicates each feature's bin id across its B output
-rows) followed by one vector compare — no gathers, no cross-lane
-reshapes.
+row grid.  The one-hot itself is produced by per-feature broadcast
+compares against a bin iota (:func:`_onehot_bins`) — no gathers, no
+cross-lane reshapes, and no intermediate beyond the bf16 one-hot.
 
 The column count adapts to the wave: ``cols = round128(C * round8(A))``,
 so MXU work scales with the number of active leaves — the first waves of
@@ -51,14 +50,54 @@ import os
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
 LANE = 128
-DEFAULT_ROW_TILE = 1024
-# cap for the [Ft*B, cols] f32 VMEM accumulator
-_ACC_VMEM_BYTES = 6 * 1024 * 1024
+# rows per kernel grid step; env-tunable for A/B perf work.  2048 beats
+# 1024 by ~5% on the bench (fewer grid steps to amortize per-tile fixed
+# cost); kernels halve it per-config when the VMEM cell won't fit (high
+# bin counts).  transpose_bins/pack_values pad to this, so any power-of-
+# two tile <= it divides n_pad; pallas_route imports it for the same
+# reason.
+DEFAULT_ROW_TILE = int(os.environ.get("LGBM_TPU_ROW_TILE", 2048))
+# per-grid-cell VMEM budget for the histogram kernel's resident arrays
+# (f32 accumulator + bf16 one-hot + bins tile + value columns).  Sized
+# to what the previous spread-matmul kernel demonstrably ran on the v5e
+# (larger footprints compiled and executed); the streamed inputs'
+# double-buffering is counted inside _cell_vmem_bytes.
+_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+
+def _cell_vmem_bytes(ft: int, B: int, cols: int, T: int, C: int) -> int:
+    """VMEM footprint of one (feature-tile, row-tile) grid cell: the f32
+    accumulator, the bf16 one-hot, the weighted value block, the bins
+    tile (double-buffered), and the packed values."""
+    return (ft * B * cols * 4        # accumulator (out block)
+            + ft * B * T * 2         # one-hot bf16
+            + T * cols * 2           # vw bf16
+            + 2 * ft * T             # bins tile, double-buffered
+            + 2 * T * C * 4)         # vals, double-buffered
+
+
+def _feat_tile_cap(B: int, cols: int, T: int, C: int) -> int:
+    """Largest feature tile whose grid cell fits the VMEM budget."""
+    ft = max(1, _VMEM_BUDGET_BYTES // (B * (cols * 4 + T * 2)))
+    while ft > 1 and _cell_vmem_bytes(ft, B, cols, T, C) > _VMEM_BUDGET_BYTES:
+        ft -= 1
+    return ft
+
+
+def _pick_row_tile(n_pad: int, B: int, cols: int, C: int,
+                   requested: int) -> int:
+    """Largest power-of-two tile <= `requested` that divides ``n_pad``
+    and whose minimum-feature-tile grid cell fits the VMEM budget."""
+    T = requested
+    while T > 1024 and (
+            n_pad % T != 0
+            or _cell_vmem_bytes(8, B, cols, T, C) > _VMEM_BUDGET_BYTES):
+        T //= 2
+    return T
 
 
 def _next_pow2(x: int) -> int:
@@ -100,8 +139,11 @@ def pallas_config_ok(max_bins: int, num_leaves: int, mode: str) -> bool:
     B = bin_stride(max_bins)
     # the staged wave plan (learner/serial.py stage_plan) caps active
     # slots at 128 regardless of num_leaves
-    _, _, cols = _col_layout(min(max(1, num_leaves // 2), 128), mode)
-    return 8 * B * cols * 4 <= 12 * 1024 * 1024
+    C, _, cols = _col_layout(min(max(1, num_leaves // 2), 128), mode)
+    # the minimum feature tile of 8 must fit the full VMEM model at the
+    # 1024-row fallback tile (_pick_row_tile halves down to it) —
+    # ADVICE r2: the accumulator alone under-counts
+    return _cell_vmem_bytes(8, B, cols, 1024, C) <= _VMEM_BUDGET_BYTES
 
 
 def transpose_bins(bins: jnp.ndarray, row_tile: int = DEFAULT_ROW_TILE,
@@ -137,15 +179,21 @@ def pack_values(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
     return vals
 
 
-def _spread_matrix(feat_tile: int, B: int) -> np.ndarray:
-    """``[Ft*B, Ft]`` bf16 constant: ``spread[f*B+b, f] = 1``."""
-    s = np.zeros((feat_tile * B, feat_tile), np.float32)
-    for f in range(feat_tile):
-        s[f * B:(f + 1) * B, f] = 1.0
-    return s.astype(jnp.bfloat16)
+def _onehot_bins(bins_i32: jnp.ndarray, B: int) -> jnp.ndarray:
+    """``[Ft, T] i32 -> [Ft*B, T] bf16`` joint (feature, bin) one-hot.
+
+    Built by per-feature broadcast-compares against a bin iota — no
+    matmul, no f32 intermediate: the only materialized array is the bf16
+    one-hot itself (the previous spread-matmul formulation wrote an extra
+    ``[Ft*B, T]`` f32 and re-read it, tripling the build's VMEM traffic)."""
+    Ft, T = bins_i32.shape
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, T), 0)
+    rows = [(bins_i32[f:f + 1, :] == iota_b).astype(jnp.bfloat16)
+            for f in range(Ft)]
+    return jnp.concatenate(rows, axis=0)
 
 
-def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref, spread_ref,
+def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref,
                  out_ref, *, n_cols: int, B: int, pad_cols: int):
     """One (feature-tile, row-tile) grid cell; accumulates over row tiles."""
     rt = pl.program_id(1)
@@ -154,13 +202,8 @@ def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref, spread_ref,
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
-    # [Ft*B, T] — each feature's bin id replicated across its B rows
-    binsrep = jnp.dot(spread_ref[:],
-                      bins_ref[:].astype(jnp.int32).astype(jnp.bfloat16),
-                      preferred_element_type=jnp.float32)
-    brow = jax.lax.broadcasted_iota(
-        jnp.int32, binsrep.shape, 0) & (B - 1)
-    oh = (binsrep == brow.astype(jnp.float32)).astype(jnp.bfloat16)
+    # [Ft*B, T] joint (feature, bin) one-hot
+    oh = _onehot_bins(bins_ref[:].astype(jnp.int32), B)
 
     # [T, A_pad] leaf membership mask over the active-leaf list
     m = (leaf_ref[:] == active_ref[:]).astype(jnp.bfloat16)
@@ -214,15 +257,17 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     C = vals.shape[1]
     A = active.shape[0]
     B = bin_stride(max_bins)
-    T = row_tile
-    assert n_pad % T == 0, (n_pad, T)
 
     _, A_pad, cols = _col_layout(A, "hilo" if C == 5 else "bf16")
+    T = _pick_row_tile(n_pad, B, cols, C, row_tile)
+    assert n_pad % T == 0, (n_pad, T)
     pad_cols = cols - C * A_pad
-    # feature tile: bounded by the f32 accumulator's VMEM budget; when
-    # tiling, the block's sublane dim must be a multiple of 8 (Mosaic
-    # tiling constraint — a full-array block is exempt)
-    ft_cap = max(1, _ACC_VMEM_BYTES // (B * cols * 4))
+    # feature tile: bounded by the per-grid-cell VMEM footprint (f32
+    # accumulator + the bf16 one-hot + the bins tile — ADVICE r2: the
+    # accumulator alone under-counts by the one-hot's tens of MB on wide
+    # low-bin datasets); when tiling, the block's sublane dim must be a
+    # multiple of 8 (Mosaic tiling constraint — full-array is exempt)
+    ft_cap = max(1, _feat_tile_cap(B, cols, T, C))
     if ft_cap >= F_pad:
         feat_tile = F_pad
     else:
@@ -240,8 +285,6 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     # padded rows carry leaf -1; bagged-out rows carry -1 too.  Use -2 for
     # active padding so neither lands in a real column block; -1 actives
     # (wave padding) DO accumulate bagged-out rows, caller drops them.
-    spread = jnp.asarray(_spread_matrix(feat_tile, B))
-
     grid = (F_grid // feat_tile, n_pad // T)
     out = pl.pallas_call(
         functools.partial(_hist_kernel, n_cols=C, B=B, pad_cols=pad_cols),
@@ -255,15 +298,13 @@ def hist_active_pallas(bins_t: jnp.ndarray,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((T, 1), lambda f, r: (r, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((feat_tile * B, feat_tile), lambda f, r: (0, 0),
-                         memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((feat_tile * B, cols),
                                lambda f, r: (f, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct((F_grid * B, cols), jnp.float32),
         interpret=interpret,
-    )(act, bins_t, vals, leaf, spread)
+    )(act, bins_t, vals, leaf)
 
     # [F_grid*B, cols] -> [A, F, B, C'] -> combine hi/lo -> [A, F, B, 3]
     out = out.reshape(F_grid, B, cols)[:, :, :C * A_pad]
@@ -321,7 +362,7 @@ def default_backend() -> str:
 # Fused route + histogram kernel: one bins stream per wave instead of two
 # ---------------------------------------------------------------------------
 def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
-                       cat_ref, spread_ref, out_ref, leaf2_out_ref, *,
+                       cat_ref, out_ref, leaf2_out_ref, *,
                        n_cols: int, B: int, Bcat: int, pad_cols: int):
     """Apply the previous wave's pending splits to the leaf vectors, then
     histogram the active leaves — both from ONE VMEM-resident bins tile.
@@ -344,7 +385,11 @@ def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
     leaf = leaf2_ref[0:1, :]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
     ohL = (iota_l == leaf).astype(jnp.float32)
-    sel16 = jnp.dot(rtabs_ref[:], ohL, preferred_element_type=jnp.float32)
+    # HIGHEST precision: the table carries integers up to L-1 / G-1 that
+    # the default bf16 matmul pass would round past 256 (the cat dot's
+    # 0/1 operands are exact at default precision)
+    sel16 = jnp.dot(rtabs_ref[:], ohL, preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)
     g_row = sel16[_T_GROUP:_T_GROUP + 1, :]
     thr = sel16[_T_THR:_T_THR + 1, :]
     dl = sel16[_T_DL:_T_DL + 1, :]
@@ -390,10 +435,7 @@ def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
     leaf2_out_ref[1:2, :] = hl
 
     # ---- histogram with the routed in-bag leaves ----------------------
-    binsrep = jnp.dot(spread_ref[:], binsf32.astype(jnp.bfloat16),
-                      preferred_element_type=jnp.float32)
-    brow = jax.lax.broadcasted_iota(jnp.int32, binsrep.shape, 0) & (B - 1)
-    oh = (binsrep == brow.astype(jnp.float32)).astype(jnp.bfloat16)
+    oh = _onehot_bins(bins_ref[:].astype(jnp.int32), B)
     m = (hl.reshape(T, 1) == active_ref[:]).astype(jnp.bfloat16)
     vals = vals_ref[:]
     blocks = [m * vals[:, ci:ci + 1].astype(jnp.bfloat16)
@@ -414,9 +456,10 @@ def fused_config_ok(num_groups: int, max_bins: int, num_leaves: int,
     if not pallas_config_ok(max_bins, num_leaves, mode):
         return False
     B = bin_stride(max_bins)
-    _, _, cols = _col_layout(min(max(1, num_leaves // 2), 128), mode)
-    ft_cap = max(1, _ACC_VMEM_BYTES // (B * cols * 4))
-    return num_groups <= ft_cap
+    C, _, cols = _col_layout(min(max(1, num_leaves // 2), 128), mode)
+    # feasibility at the 1024-row fallback tile (the kernel halves its
+    # row tile per-config until the whole feature set fits)
+    return num_groups <= _feat_tile_cap(B, cols, 1024, C)
 
 
 @functools.partial(
@@ -442,10 +485,16 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
     C = vals.shape[1]
     A = active.shape[0]
     B = bin_stride(max_bins)
-    T = row_tile
-    assert n_pad % T == 0 and leaf2.shape == (2, n_pad)
 
     _, A_pad, cols = _col_layout(A, "hilo" if C == 5 else "bf16")
+    # the fused kernel holds ALL stored columns in one tile: halve the
+    # row tile until that cell fits the VMEM budget
+    T = row_tile
+    while T > 1024 and (
+            n_pad % T != 0
+            or _cell_vmem_bytes(F_pad, B, cols, T, C) > _VMEM_BUDGET_BYTES):
+        T //= 2
+    assert n_pad % T == 0 and leaf2.shape == (2, n_pad)
     pad_cols = cols - C * A_pad
     L = feature.shape[0]
     L_pad = _round_up(max(L, 8), LANE)
@@ -459,7 +508,6 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
     act = jnp.full((1, A_pad), -2, jnp.int32)
     act = jax.lax.dynamic_update_slice(
         act, active.astype(jnp.int32)[None, :], (0, 0))
-    spread = jnp.asarray(_spread_matrix(F_pad, B))
 
     out, leaf2_new = pl.pallas_call(
         functools.partial(_hist_route_kernel, n_cols=C, B=B, Bcat=Bcat,
@@ -478,8 +526,6 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((Bcat, L_pad), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((F_pad * B, F_pad), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
         ],
         out_specs=(
             pl.BlockSpec((F_pad * B, cols), lambda r: (0, 0),
@@ -492,7 +538,7 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
             jax.ShapeDtypeStruct((2, n_pad), jnp.int32),
         ),
         interpret=interpret,
-    )(act, bins_t, vals, leaf2, rtabs, cat, spread)
+    )(act, bins_t, vals, leaf2, rtabs, cat)
 
     out = out.reshape(F_pad, B, cols)[:, :, :C * A_pad]
     out = out.reshape(F_pad, B, C, A_pad)

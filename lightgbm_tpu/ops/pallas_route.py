@@ -42,8 +42,9 @@ from jax.experimental.pallas import tpu as pltpu
 
 from ..io.binning import MISSING_NAN, MISSING_ZERO
 
+from .pallas_histogram import DEFAULT_ROW_TILE
+
 LANE = 128
-DEFAULT_ROW_TILE = 1024
 
 # tabs row layout (per-leaf split decision table)
 _T_GROUP, _T_THR, _T_DL, _T_ISCAT, _T_SEL, _T_NEWID = 0, 1, 2, 3, 4, 5
@@ -63,8 +64,13 @@ def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int):
 
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
     ohL = (iota_l == leaf).astype(jnp.float32)                # [L_pad, T]
+    # HIGHEST precision: table rows carry integers up to L-1 / G-1 which
+    # bf16 (the TPU's default matmul pass) would round past 256.  The
+    # cat/ohL dots below stay at default precision — 0/1 operands are
+    # exact in bf16 and the MXU accumulates in f32.
     sel16 = jnp.dot(tabs_ref[:], ohL,
-                    preferred_element_type=jnp.float32)       # [16, T]
+                    preferred_element_type=jnp.float32,
+                    precision=jax.lax.Precision.HIGHEST)      # [16, T]
     g_row = sel16[_T_GROUP:_T_GROUP + 1, :]
     thr = sel16[_T_THR:_T_THR + 1, :]
     dl = sel16[_T_DL:_T_DL + 1, :]

@@ -74,7 +74,8 @@ def _sync_global_best(best: SplitResult, axis: str) -> SplitResult:
 def make_feature_parallel_strategy(data: DeviceData, grad, hess,
                                    params: GrowthParams, feature_mask,
                                    axis: str, num_shards: int,
-                                   hist_backend: str = "auto"):
+                                   hist_backend: str = "auto",
+                                   hist_mode=None):
     """Features statically sliced per shard; per-shard histogram state
     covers only the local columns; global best via all_gather + argmax."""
     if data.is_bundled:
@@ -99,7 +100,8 @@ def make_feature_parallel_strategy(data: DeviceData, grad, hess,
                           jnp.full(f_local, -1, jnp.int32),
                           data.total_bins, data.max_bins,
                           data.has_categorical)
-    hist_fn = make_hist_fn(data_loc, grad, hess, L, hist_backend)
+    hist_fn = make_hist_fn(data_loc, grad, hess, L, hist_backend,
+                           hist_mode)
 
     # mask features overlapping a previous shard (end-clamp duplicates)
     fid_global = start + jnp.arange(f_local)
@@ -132,13 +134,14 @@ def make_feature_parallel_strategy(data: DeviceData, grad, hess,
 def make_voting_parallel_strategy(data: DeviceData, grad, hess,
                                   params: GrowthParams, feature_mask,
                                   axis: str, num_shards: int, top_k: int,
-                                  hist_backend: str = "auto"):
+                                  hist_backend: str = "auto",
+                                  hist_mode=None):
     """PV-Tree: local active-leaf hists -> local vote -> global top-2k
     features -> psum only their histogram columns -> final scan."""
     F = data.num_features
     L = params.num_leaves
     k2 = min(2 * top_k, F)
-    hist_fn = make_hist_fn(data, grad, hess, L, hist_backend)
+    hist_fn = make_hist_fn(data, grad, hess, L, hist_backend, hist_mode)
     # local constraints scaled 1/S like the reference
     # (voting_parallel_tree_learner.cpp:55-56)
     local_params = params.split._replace(
@@ -243,7 +246,8 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
                            params: GrowthParams,
                            bag_mask=None, feature_mask=None,
                            top_k: int = 20,
-                           hist_backend: str = "auto") -> BuiltTree:
+                           hist_backend: str = "auto",
+                           hist_mode=None) -> BuiltTree:
     """Run one tree build as an SPMD program over `mesh`.
 
     Row-sharded inputs (data/voting): ``bins``, ``grad``, ``hess``,
@@ -277,19 +281,19 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
         elif learner_type == "feature":
             strategy, nhf = make_feature_parallel_strategy(
                 data_l, grad_l, hess_l, params, fmask_l, axis, num_shards,
-                hist_backend)
+                hist_backend, hist_mode)
             psum_fn = None
         elif learner_type == "voting":
             strategy = make_voting_parallel_strategy(
                 data_l, grad_l, hess_l, params, fmask_l, axis, num_shards,
-                top_k, hist_backend)
+                top_k, hist_backend, hist_mode)
             psum_fn = _psum(axis)
         else:
             raise ValueError(learner_type)
         return build_tree(data_l, grad_l, hess_l, params, bag_mask=bag_l,
                           feature_mask=fmask_l, strategy=strategy,
                           psum_fn=psum_fn, hist_backend=hist_backend,
-                          num_hist_features=nhf)
+                          num_hist_features=nhf, hist_mode=hist_mode)
 
     out_spec = BuiltTree(
         feature=P(), threshold_bin=P(), default_left=P(), is_categorical=P(),

@@ -29,6 +29,15 @@ def enabled() -> bool:
     return os.environ.get("LGBM_TPU_TIMETAG", "0") not in ("", "0", "false")
 
 
+def phases_enabled() -> bool:
+    """``LGBM_TPU_TIMETAG=phases``: run the tree learner's waves as
+    separate dispatches with per-phase tags (route/hist/scan/update)
+    instead of one fused program — the reference's per-phase TIMETAG
+    counters (`serial_tree_learner.cpp:12-39`).  Slower (one host round
+    trip per phase); ratios are the signal, not sums."""
+    return os.environ.get("LGBM_TPU_TIMETAG", "") == "phases"
+
+
 def _block(x):
     try:
         import jax

@@ -60,3 +60,16 @@ def test_num_tree_per_iteration():
     cfg = Config.from_params({"objective": "multiclass", "num_class": 4})
     assert cfg.num_tree_per_iteration == 4
     assert Config.from_params({}).num_tree_per_iteration == 1
+
+
+def test_hist_mode_and_gpu_use_dp():
+    """hist_mode is the gpu_use_dp analog (ADVICE r2): config-exposed,
+    validated, and gpu_use_dp=true maps to the high-precision mode."""
+    assert Config.from_params({}).hist_mode == ""
+    assert Config.from_params({"hist_mode": "hilo"}).hist_mode == "hilo"
+    assert Config.from_params({"gpu_use_dp": "true"}).hist_mode == "hilo"
+    # explicit hist_mode wins over gpu_use_dp
+    assert Config.from_params(
+        {"gpu_use_dp": "true", "hist_mode": "bf16"}).hist_mode == "bf16"
+    with pytest.raises(ValueError):
+        Config.from_params({"hist_mode": "f64"})
