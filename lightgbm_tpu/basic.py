@@ -300,7 +300,11 @@ class Booster:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else -1)
         if pred_leaf:
-            return self._gbdt.predict_leaf(X)
+            leaves = self._gbdt.predict_leaf(X)
+            if num_iteration and num_iteration > 0:
+                T = num_iteration * max(1, self._gbdt.num_tree_per_iteration)
+                leaves = leaves[:, :T]
+            return leaves
         if pred_contrib:
             from .boosting.contrib import predict_contrib
             return predict_contrib(self._gbdt, X, num_iteration)

@@ -181,6 +181,13 @@ class GBDT:
         self._query = train_set.metadata.query_boundaries
         self._setup_metrics()
 
+        self._setup_build_program()
+
+    def _setup_build_program(self) -> None:
+        """(Re)build the jitted tree-build closure from the CURRENT config
+        and growth params; called at init and after ``reset_config`` (a
+        stale closure would silently keep the old hyperparameters)."""
+        c = self.config
         # one jitted tree-build program, traced once per (shapes, params)
         growth = self.growth
         if self.mesh_ctx is None:
@@ -556,6 +563,68 @@ class GBDT:
                 self._valid_scores[i] = self._valid_scores[i].at[:, kk].add(-vpred)
         self.iter -= 1
         self._stacked_cache = None
+
+    def merge_from(self, other: "GBDT") -> None:
+        """Append the other booster's trees (reference GBDT::MergeFrom,
+        gbdt.h:50-67).  Scores are refreshed from the merged trees when a
+        train set is attached."""
+        if other.num_tree_per_iteration != self.num_tree_per_iteration:
+            raise ValueError("cannot merge boosters with different "
+                             "num_tree_per_iteration")
+        new = list(other.models)
+        self.models = self.models + new
+        K = max(1, self.num_tree_per_iteration)
+        self.iter = len(self._host_models) // K
+        if self.train_set is not None:
+            for j, tree in enumerate(new):
+                kk = j % K
+                pred = self._predict_host_tree_binned(tree, self.device_data)
+                self.scores = self.scores.at[:, kk].add(pred)
+                for i, vd in enumerate(self._valid_device):
+                    vpred = self._predict_host_tree_binned(tree, vd)
+                    self._valid_scores[i] = (
+                        self._valid_scores[i].at[:, kk].add(vpred))
+        self._stacked_cache = None
+
+    def load_model_trees(self, text: str) -> None:
+        """Install a saved model's trees into THIS booster, keeping its
+        train set and config (ResetTrainingData continue path,
+        c_api.h:382-389): scores are replayed so further training
+        continues from the loaded model."""
+        donor = GBDT(self.config, None)
+        donor.load_model_from_string(text)
+        self.models = []
+        self.iter = 0
+        self.merge_from(donor)
+
+    def reset_config(self, params: Dict[str, str]) -> None:
+        """Reference ResetConfig (c_api.cpp Booster::ResetConfig): re-read
+        training hyperparameters; the dataset and model are kept."""
+        from ..config import canonicalize_params
+        self.config.update(canonicalize_params(dict(params)))
+        self.config.check()
+        self.shrinkage_rate = self.config.learning_rate
+        if self.train_set is not None:
+            self.growth = growth_params_from_config(self.config)
+            self._setup_metrics()
+            self._setup_build_program()   # drop stale growth/hist closures
+
+    def set_leaf_value(self, tree_idx: int, leaf_idx: int,
+                      val: float) -> None:
+        """Reference SetLeafValue (c_api.h:723-734); adjusts train scores
+        by the delta like GBDT does via the score updater."""
+        models = self.models
+        tree = models[tree_idx]
+        old = float(tree.leaf_value[leaf_idx])
+        tree.leaf_value[leaf_idx] = val
+        self._stacked_cache = None
+        if self.train_set is not None and abs(val - old) > 0:
+            kk = tree_idx % max(1, self.num_tree_per_iteration)
+            pred_new = self._predict_host_tree_binned(tree, self.device_data)
+            tree.leaf_value[leaf_idx] = old
+            pred_old = self._predict_host_tree_binned(tree, self.device_data)
+            tree.leaf_value[leaf_idx] = val
+            self.scores = self.scores.at[:, kk].add(pred_new - pred_old)
 
     # ------------------------------------------------------------------
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:

@@ -170,6 +170,18 @@ def _parse_libsvm(path: str, skip: int) -> Tuple[np.ndarray, np.ndarray]:
     return X, np.asarray(labels, np.float32)
 
 
+def load_raw_matrix(path: str, has_header: bool = False
+                    ) -> Tuple[np.ndarray, Optional[np.ndarray]]:
+    """Prediction-input parse: ``-> (X, label_or_None)`` with the same
+    format autodetection and label-column convention as training files
+    (reference Predictor file flow, `src/application/predictor.hpp:115+`
+    reuses the training Parser, so column 0 / the LibSVM label token is
+    stripped from the features)."""
+    cfg = Config.from_params({"has_header": has_header})
+    X, label, _, _, _, _ = parse_file(path, cfg)
+    return X, label
+
+
 def _load_side_file(path: str, dtype=np.float32) -> Optional[np.ndarray]:
     from ..utils.file_io import release
     try:
@@ -258,6 +270,13 @@ def load_file(path: str, config: Config,
                                     metadata=md)
         return ds
     mappers = None
+    if num_machines > 1 and allgather is None:
+        # a host app may have injected its own collective backend
+        # (LGBM_NetworkInitWithFunctions -> install_external_collectives)
+        from .distributed import external_collectives
+        ext = external_collectives()
+        if ext is not None:
+            allgather = ext.allgather
     if num_machines > 1 and allgather is not None:
         from .distributed import find_bins_distributed
         mappers = find_bins_distributed(X, config, rank, num_machines,

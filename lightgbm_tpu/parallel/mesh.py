@@ -45,6 +45,62 @@ def init_distributed(coordinator_address: Optional[str] = None,
     jax.distributed.initialize(**kwargs)
 
 
+def init_distributed_from_machines(machines: str, local_listen_port: int,
+                                   num_machines: int) -> None:
+    """LGBM_NetworkInit semantics (c_api.h:749-756): a comma-separated
+    ``ip:port`` machine list.  The reference resolves its own rank by
+    matching a local endpoint against the list and TCP-meshes everyone
+    (`linkers_socket.cpp:97-107,225-274`); here the first machine is the
+    ``jax.distributed`` coordinator and rank = list position, matched by
+    the local listen port (all-loopback lists work for tests)."""
+    entries = [m.strip() for m in machines.replace("\n", ",").split(",")
+               if m.strip()]
+    if num_machines > len(entries):
+        raise ValueError(
+            f"num_machines={num_machines} but machine list has "
+            f"{len(entries)} entries")
+    entries = entries[:num_machines]
+    import socket
+
+    def _is_local_ip(host: str) -> bool:
+        """Bindability test — the reference resolves its local endpoint by
+        actually binding a socket (`linkers_socket.cpp:20-78`), which works
+        where hostname DNS lies (Debian's 127.0.1.1 /etc/hosts entry)."""
+        if host in ("127.0.0.1", "localhost", "0.0.0.0"):
+            return True
+        try:
+            s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+            try:
+                s.bind((host, 0))
+                return True
+            finally:
+                s.close()
+        except OSError:
+            return False
+
+    # rank = the local entry; when several entries are local (all-loopback
+    # test lists), the listen port disambiguates — port matching only
+    # applies AMONG local entries, else the shared-port multi-host setup
+    # (every machine listening on the same port) would resolve rank 0
+    # everywhere
+    local = [i for i, e in enumerate(entries)
+             if _is_local_ip(e.rsplit(":", 1)[0])]
+    if len(local) == 1:
+        rank = local[0]
+    else:
+        cands = local if local else range(len(entries))
+        matches = [i for i in cands
+                   if ":" in entries[i]
+                   and int(entries[i].rsplit(":", 1)[1]) == local_listen_port]
+        if len(matches) != 1:
+            raise ValueError(
+                "cannot resolve local rank from machine list "
+                f"{entries!r} with local_listen_port={local_listen_port}")
+        rank = matches[0]
+    init_distributed(coordinator_address=entries[0],
+                     num_processes=num_machines, process_id=rank)
+
+
 class MeshContext:
     """A 1-D (data) or 2-D (data × feature) device mesh + shard helpers."""
 
