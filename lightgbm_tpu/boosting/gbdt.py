@@ -414,7 +414,8 @@ class GBDT:
             # row_leaf ([n]) is only needed for the score update above —
             # drop it so pending trees don't pin O(iters x n) HBM or ship
             # dead bytes through the batched device_get
-            self._pending.append((bt._replace(row_leaf=bt.row_leaf[:0]),
+            self._pending.append((bt._replace(row_leaf=bt.row_leaf[:0],
+                                              row_value=bt.row_value[:0]),
                                   self.shrinkage_rate, bias, 1))
         self.iter += 1
         self._stacked_cache = None
@@ -469,8 +470,15 @@ class GBDT:
 
     def _update_scores(self, bt: BuiltTree, k: int) -> None:
         lr = self.shrinkage_rate
-        self.scores = self.scores.at[:, k].add(
-            lr * bt.leaf_value[bt.row_leaf])
+        if bt.row_value.shape[0] and not (
+                self.objective is not None
+                and self.objective.need_renew_tree_output):
+            # kernel-emitted per-row values (no gather); renewal rewrites
+            # leaf_value after emission, so it must take the gather path
+            self.scores = self.scores.at[:, k].add(lr * bt.row_value)
+        else:
+            self.scores = self.scores.at[:, k].add(
+                lr * bt.leaf_value[bt.row_leaf])
         for i, vd in enumerate(self._valid_device):
             pred = predict_built_tree(bt, vd, vd.bins)
             self._valid_scores[i] = self._valid_scores[i].at[:, k].add(lr * pred)
@@ -700,8 +708,14 @@ class GBDT:
                     lv = jnp.where(bt.num_leaves > 1, bt.leaf_value,
                                    jnp.zeros_like(bt.leaf_value))
                     bt = bt._replace(leaf_value=lv)
-                    scores = scores.at[:, k].add(lr * lv[bt.row_leaf])
-                    outs.append(bt._replace(row_leaf=bt.row_leaf[:0]))
+                    if bt.row_value.shape[0]:
+                        # emitted by the final route kernel (already
+                        # stump-masked); avoids the 1M-row gather
+                        scores = scores.at[:, k].add(lr * bt.row_value)
+                    else:
+                        scores = scores.at[:, k].add(lr * lv[bt.row_leaf])
+                    outs.append(bt._replace(row_leaf=bt.row_leaf[:0],
+                                            row_value=bt.row_value[:0]))
                 stacked = (outs[0] if K == 1 else
                            jax.tree.map(lambda *xs: jnp.stack(xs), *outs))
                 return scores, stacked

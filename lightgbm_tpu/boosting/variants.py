@@ -155,6 +155,11 @@ class GOSS(GBDT):
             if int(bt.num_leaves) > 1:
                 finished = False
             bt = self._renew_leaves(bt, k)
+            # stump => zero contribution (gbdt.cpp:435-460), matching the
+            # stump-masked row_value the Pallas path emits
+            bt = bt._replace(leaf_value=jnp.where(
+                bt.num_leaves > 1, bt.leaf_value,
+                jnp.zeros_like(bt.leaf_value)))
             self._update_scores(bt, k)
             host = self._to_host_tree(bt)
             host.shrinkage(self.shrinkage_rate)

@@ -159,24 +159,31 @@ def transpose_bins(bins: jnp.ndarray, row_tile: int = DEFAULT_ROW_TILE,
 
 def pack_values(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
                 row_tile: int = DEFAULT_ROW_TILE) -> jnp.ndarray:
-    """Build the per-row value columns ``[n_pad, C]`` once per tree.
+    """Build the per-row value rows ``[C, n_pad]`` once per tree.
 
     mode="bf16": C=3 ``(g, h, 1)``; mode="hilo": C=5
     ``(g_hi, g_lo, h_hi, h_lo, 1)`` with ``x == x_hi + x_lo`` to ~2^-17.
+
+    Rows-on-lanes layout: the row dimension is the minor (lane) axis both
+    here and in the kernels, so the host-side pad/stack write dense
+    ``[C, n]`` tiles (the previous ``[n, C]`` layout put C=3 on lanes —
+    a ~2.3 ms/iter pad+copy at 1M rows); padding rows carry 0.
     """
     n = grad.shape[0]
-    ones = jnp.ones_like(grad)
+    n_pad = _round_up(n, row_tile)
+    pad = (0, n_pad - n)
+
+    def p(x):
+        return jnp.pad(x.astype(jnp.float32), pad)
+
     if mode == "hilo":
         g_hi = grad.astype(jnp.bfloat16).astype(jnp.float32)
         h_hi = hess.astype(jnp.bfloat16).astype(jnp.float32)
-        cols = [g_hi, grad - g_hi, h_hi, hess - h_hi, ones]
+        rows = [p(g_hi), p(grad - g_hi), p(h_hi), p(hess - h_hi),
+                p(jnp.ones_like(grad))]
     else:
-        cols = [grad, hess, ones]
-    vals = jnp.stack(cols, axis=-1).astype(jnp.float32)
-    n_pad = _round_up(n, row_tile)
-    if n_pad != n:
-        vals = jnp.pad(vals, ((0, n_pad - n), (0, 0)))
-    return vals
+        rows = [p(grad), p(hess), p(jnp.ones_like(grad))]
+    return jnp.stack(rows, axis=0)
 
 
 def _onehot_bins(bins_i32: jnp.ndarray, B: int) -> jnp.ndarray:
@@ -195,7 +202,13 @@ def _onehot_bins(bins_i32: jnp.ndarray, B: int) -> jnp.ndarray:
 
 def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref,
                  out_ref, *, n_cols: int, B: int, pad_cols: int):
-    """One (feature-tile, row-tile) grid cell; accumulates over row tiles."""
+    """One (feature-tile, row-tile) grid cell; accumulates over row tiles.
+
+    Everything rides rows-on-lanes: the leaf mask is built ``[A_pad, T]``
+    (no per-tile transpose of the leaf row) and the weighted values as
+    ``vw [cols, T]``, contracted against the one-hot on the lane
+    dimension of BOTH operands.
+    """
     rt = pl.program_id(1)
 
     @pl.when(rt == 0)
@@ -205,16 +218,16 @@ def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref,
     # [Ft*B, T] joint (feature, bin) one-hot
     oh = _onehot_bins(bins_ref[:].astype(jnp.int32), B)
 
-    # [T, A_pad] leaf membership mask over the active-leaf list
-    m = (leaf_ref[:] == active_ref[:]).astype(jnp.bfloat16)
-    vals = vals_ref[:]                                       # [T, C] f32
-    blocks = [m * vals[:, c:c + 1].astype(jnp.bfloat16) for c in range(n_cols)]
+    # [A_pad, T] leaf membership mask over the active-leaf list
+    m = (active_ref[:] == leaf_ref[:]).astype(jnp.bfloat16)
+    vals = vals_ref[:]                                       # [C, T] f32
+    blocks = [m * vals[c:c + 1, :].astype(jnp.bfloat16) for c in range(n_cols)]
     if pad_cols:
-        blocks.append(jnp.zeros((m.shape[0], pad_cols), jnp.bfloat16))
-    vw = jnp.concatenate(blocks, axis=1)                     # [T, cols]
+        blocks.append(jnp.zeros((pad_cols, m.shape[1]), jnp.bfloat16))
+    vw = jnp.concatenate(blocks, axis=0)                     # [cols, T]
 
     out_ref[:] += jax.lax.dot_general(
-        oh, vw, (((1,), (0,)), ((), ())),
+        oh, vw, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
@@ -237,7 +250,7 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     Args:
       bins_t: ``[F_pad, n_pad]`` uint8 transposed binned matrix
         (:func:`transpose_bins`).
-      vals: ``[n_pad, C]`` f32 packed value columns (:func:`pack_values`).
+      vals: ``[C, n_pad]`` f32 packed value rows (:func:`pack_values`).
       row_leaf: ``[n]`` int32 leaf per row; rows whose leaf is not in
         `active` (including bagged-out ``-1``) contribute nothing.
       active: ``[A]`` int32 leaf ids to histogram; ``-1`` entries are
@@ -254,7 +267,7 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     proportionally cheap.
     """
     F_pad, n_pad = bins_t.shape
-    C = vals.shape[1]
+    C = vals.shape[0]
     A = active.shape[0]
     B = bin_stride(max_bins)
 
@@ -276,12 +289,12 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     if F_grid != F_pad:
         bins_t = jnp.pad(bins_t, ((0, F_grid - F_pad), (0, 0)))
 
-    leaf = jnp.full((n_pad, 1), -1, jnp.int32)
+    leaf = jnp.full((1, n_pad), -1, jnp.int32)
     leaf = jax.lax.dynamic_update_slice(
-        leaf, row_leaf.astype(jnp.int32)[:, None], (0, 0))
-    act = jnp.full((1, A_pad), -2, jnp.int32)
+        leaf, row_leaf.astype(jnp.int32)[None, :], (0, 0))
+    act = jnp.full((A_pad, 1), -2, jnp.int32)
     act = jax.lax.dynamic_update_slice(
-        act, active.astype(jnp.int32)[None, :], (0, 0))
+        act, active.astype(jnp.int32)[:, None], (0, 0))
     # padded rows carry leaf -1; bagged-out rows carry -1 too.  Use -2 for
     # active padding so neither lands in a real column block; -1 actives
     # (wave padding) DO accumulate bagged-out rows, caller drops them.
@@ -290,13 +303,13 @@ def hist_active_pallas(bins_t: jnp.ndarray,
         functools.partial(_hist_kernel, n_cols=C, B=B, pad_cols=pad_cols),
         grid=grid,
         in_specs=[
-            pl.BlockSpec((1, A_pad), lambda f, r: (0, 0),
+            pl.BlockSpec((A_pad, 1), lambda f, r: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((feat_tile, T), lambda f, r: (f, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((T, C), lambda f, r: (r, 0),
+            pl.BlockSpec((C, T), lambda f, r: (0, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((T, 1), lambda f, r: (r, 0),
+            pl.BlockSpec((1, T), lambda f, r: (0, r),
                          memory_space=pltpu.VMEM),
         ],
         out_specs=pl.BlockSpec((feat_tile * B, cols),
@@ -363,7 +376,8 @@ def default_backend() -> str:
 # ---------------------------------------------------------------------------
 def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
                        cat_ref, out_ref, leaf2_out_ref, *,
-                       n_cols: int, B: int, Bcat: int, pad_cols: int):
+                       n_cols: int, B: int, Bcat: int, pad_cols: int,
+                       tab_prec):
     """Apply the previous wave's pending splits to the leaf vectors, then
     histogram the active leaves — both from ONE VMEM-resident bins tile.
     The route logic matches ``ops/pallas_route.py`` (same table layout)."""
@@ -385,11 +399,11 @@ def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
     leaf = leaf2_ref[0:1, :]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
     ohL = (iota_l == leaf).astype(jnp.float32)
-    # HIGHEST precision: the table carries integers up to L-1 / G-1 that
-    # the default bf16 matmul pass would round past 256 (the cat dot's
-    # 0/1 operands are exact at default precision)
+    # tab_prec (pallas_route.table_precision): bf16-exact configs use the
+    # single default pass; ids past 256 need HIGHEST (the cat dot's 0/1
+    # operands are exact at default precision)
     sel16 = jnp.dot(rtabs_ref[:], ohL, preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST)
+                    precision=tab_prec)
     g_row = sel16[_T_GROUP:_T_GROUP + 1, :]
     thr = sel16[_T_THR:_T_THR + 1, :]
     dl = sel16[_T_DL:_T_DL + 1, :]
@@ -435,16 +449,18 @@ def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
     leaf2_out_ref[1:2, :] = hl
 
     # ---- histogram with the routed in-bag leaves ----------------------
+    # rows-on-lanes throughout: mask [A_pad, T] straight off the routed
+    # leaf row (no [1,T]->[T,1] relayout), vw [cols, T], lane contraction
     oh = _onehot_bins(bins_ref[:].astype(jnp.int32), B)
-    m = (hl.reshape(T, 1) == active_ref[:]).astype(jnp.bfloat16)
-    vals = vals_ref[:]
-    blocks = [m * vals[:, ci:ci + 1].astype(jnp.bfloat16)
+    m = (active_ref[:] == hl).astype(jnp.bfloat16)            # [A_pad, T]
+    vals = vals_ref[:]                                        # [C, T]
+    blocks = [m * vals[ci:ci + 1, :].astype(jnp.bfloat16)
               for ci in range(n_cols)]
     if pad_cols:
-        blocks.append(jnp.zeros((T, pad_cols), jnp.bfloat16))
-    vw = jnp.concatenate(blocks, axis=1)
+        blocks.append(jnp.zeros((pad_cols, T), jnp.bfloat16))
+    vw = jnp.concatenate(blocks, axis=0)                      # [cols, T]
     out_ref[:] += jax.lax.dot_general(
-        oh, vw, (((1,), (0,)), ((), ())),
+        oh, vw, (((1,), (1,)), ((), ())),
         preferred_element_type=jnp.float32)
 
 
@@ -482,7 +498,7 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
     """
     from .pallas_route import _T_ROWS, _leaf_tables
     F_pad, n_pad = bins_t.shape
-    C = vals.shape[1]
+    C = vals.shape[0]
     A = active.shape[0]
     B = bin_stride(max_bins)
 
@@ -505,20 +521,22 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
                          feat_group, feat_offset, num_bins_arr, L_pad)
     cat = jnp.zeros((Bcat, L_pad), jnp.float32)
     cat = cat.at[:, :L].set(cat_mask.T.astype(jnp.float32))
-    act = jnp.full((1, A_pad), -2, jnp.int32)
+    act = jnp.full((A_pad, 1), -2, jnp.int32)
     act = jax.lax.dynamic_update_slice(
-        act, active.astype(jnp.int32)[None, :], (0, 0))
+        act, active.astype(jnp.int32)[:, None], (0, 0))
 
+    from .pallas_route import table_precision
     out, leaf2_new = pl.pallas_call(
         functools.partial(_hist_route_kernel, n_cols=C, B=B, Bcat=Bcat,
-                          pad_cols=pad_cols),
+                          pad_cols=pad_cols,
+                          tab_prec=table_precision(L_pad, F_pad)),
         grid=(n_pad // T,),
         in_specs=[
-            pl.BlockSpec((1, A_pad), lambda r: (0, 0),
+            pl.BlockSpec((A_pad, 1), lambda r: (0, 0),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((F_pad, T), lambda r: (0, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((T, C), lambda r: (r, 0),
+            pl.BlockSpec((C, T), lambda r: (0, r),
                          memory_space=pltpu.VMEM),
             pl.BlockSpec((2, T), lambda r: (0, r),
                          memory_space=pltpu.VMEM),

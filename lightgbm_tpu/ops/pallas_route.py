@@ -49,6 +49,10 @@ LANE = 128
 # tabs row layout (per-leaf split decision table)
 _T_GROUP, _T_THR, _T_DL, _T_ISCAT, _T_SEL, _T_NEWID = 0, 1, 2, 3, 4, 5
 _T_OFF, _T_NB, _T_DB, _T_MT, _T_NANB = 6, 7, 8, 9, 10
+# per-leaf OUTPUT value as a hi+lo bf16 pair (exact to ~2^-17 through the
+# bf16 MXU pass) — used by the final per-tree route to emit each row's
+# leaf value, replacing the ~7ms/iter XLA gather lv[row_leaf]
+_T_LVH, _T_LVL = 11, 12
 _T_ROWS = 16
 
 
@@ -56,7 +60,26 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
-def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int):
+def table_precision(L_pad: int, num_groups: int):
+    """MXU precision for the per-leaf table selection dot.
+
+    The table rows carry integers (leaf ids < L, group ids < G, bin ids
+    < 256).  bf16 holds integers exactly up to 256, so when every value
+    fits, the default single-pass bf16 dot is exact and 6x cheaper than
+    HIGHEST (f32-via-bf16x6); larger configs keep HIGHEST."""
+    if L_pad <= 256 and num_groups <= 256:
+        return jax.lax.Precision.DEFAULT
+    return jax.lax.Precision.HIGHEST
+
+
+def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *,
+                  B: int, tab_prec=jax.lax.Precision.HIGHEST):
+    _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, B=B,
+                tab_prec=tab_prec)
+
+
+def _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int,
+                tab_prec=jax.lax.Precision.HIGHEST):
     leaf = leaf2_ref[0:1, :]                                  # [1, T] i32
     T = leaf.shape[1]
     L_pad = tabs_ref.shape[1]
@@ -64,13 +87,13 @@ def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int):
 
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
     ohL = (iota_l == leaf).astype(jnp.float32)                # [L_pad, T]
-    # HIGHEST precision: table rows carry integers up to L-1 / G-1 which
-    # bf16 (the TPU's default matmul pass) would round past 256.  The
-    # cat/ohL dots below stay at default precision — 0/1 operands are
-    # exact in bf16 and the MXU accumulates in f32.
+    # tab_prec (see table_precision): bf16-exact configs use the single
+    # default pass; larger ids need HIGHEST.  The cat/ohL dots below stay
+    # at default precision — 0/1 operands are exact in bf16 and the MXU
+    # accumulates in f32.
     sel16 = jnp.dot(tabs_ref[:], ohL,
                     preferred_element_type=jnp.float32,
-                    precision=jax.lax.Precision.HIGHEST)      # [16, T]
+                    precision=tab_prec)                       # [16, T]
     g_row = sel16[_T_GROUP:_T_GROUP + 1, :]
     thr = sel16[_T_THR:_T_THR + 1, :]
     dl = sel16[_T_DL:_T_DL + 1, :]
@@ -122,11 +145,31 @@ def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int):
     hl = leaf2_ref[1:2, :]
     out_ref[0:1, :] = rl
     out_ref[1:2, :] = jnp.where(hl >= 0, rl, hl)              # hist_leaf'
+    return rl
+
+
+def _route_values_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref,
+                         val_ref, *, B: int,
+                         tab_prec=jax.lax.Precision.HIGHEST):
+    """Route + emit each row's POST-route leaf value (final tree pass).
+
+    The value rides the tabs as a hi+lo bf16 pair selected by a second
+    leaf one-hot built from the routed ids; rows outside the tree
+    (leaf -1, padding) emit 0."""
+    rl = _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, B=B,
+                     tab_prec=tab_prec)
+    T = rl.shape[1]
+    L_pad = tabs_ref.shape[1]
+    iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
+    ohL2 = (iota_l == rl).astype(jnp.float32)
+    sel2 = jnp.dot(tabs_ref[_T_LVH:_T_LVL + 1, :], ohL2,
+                   preferred_element_type=jnp.float32)        # [2, T]
+    val_ref[0:1, :] = sel2[0:1, :] + sel2[1:2, :]
 
 
 def _leaf_tables(feature, threshold, default_left, is_categorical, sel,
                  new_id, missing_types, nan_bins, default_bins, feat_group,
-                 feat_offset, num_bins, L_pad):
+                 feat_offset, num_bins, L_pad, leaf_values=None):
     """Pack the [16, L_pad] per-leaf decision table (tiny [L] gathers)."""
     L = feature.shape[0]
     f = feature
@@ -142,6 +185,11 @@ def _leaf_tables(feature, threshold, default_left, is_categorical, sel,
     tabs = tabs.at[_T_DB, :L].set(default_bins[f].astype(jnp.float32))
     tabs = tabs.at[_T_MT, :L].set(missing_types[f].astype(jnp.float32))
     tabs = tabs.at[_T_NANB, :L].set(nan_bins[f].astype(jnp.float32))
+    if leaf_values is not None:
+        lv = leaf_values.astype(jnp.float32)
+        hi = lv.astype(jnp.bfloat16).astype(jnp.float32)
+        tabs = tabs.at[_T_LVH, :L].set(hi)
+        tabs = tabs.at[_T_LVL, :L].set(lv - hi)
     return tabs
 
 
@@ -181,37 +229,106 @@ def route_rows_pallas(bins_t: jnp.ndarray,
 
     Rows whose leaf is unselected, bagged out, or padding are unchanged.
     """
+    return _route_call(bins_t, leaf2, feature, threshold, default_left,
+                       is_categorical, cat_mask, sel, new_id, missing_types,
+                       nan_bins, default_bins, feat_group, feat_offset,
+                       num_bins, None, row_tile, interpret)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("row_tile", "interpret"))
+def route_rows_values_pallas(bins_t: jnp.ndarray,
+                             leaf2: jnp.ndarray,
+                             feature: jnp.ndarray,
+                             threshold: jnp.ndarray,
+                             default_left: jnp.ndarray,
+                             is_categorical: jnp.ndarray,
+                             cat_mask: jnp.ndarray,
+                             sel: jnp.ndarray,
+                             new_id: jnp.ndarray,
+                             missing_types: jnp.ndarray,
+                             nan_bins: jnp.ndarray,
+                             default_bins: jnp.ndarray,
+                             feat_group: jnp.ndarray,
+                             feat_offset: jnp.ndarray,
+                             num_bins: jnp.ndarray,
+                             leaf_values: jnp.ndarray,
+                             *,
+                             row_tile: int = DEFAULT_ROW_TILE,
+                             interpret: bool = False):
+    """Final per-tree route: apply pending splits AND emit each row's
+    leaf value — ``-> (leaf2 [2, n_pad] i32, values [n_pad] f32)``.
+
+    Replaces the score-update gather ``leaf_value[row_leaf]`` (an
+    XLA-serialized ~7 ms/iter op at 1M rows) with one extra table-row
+    dot inside the route pass.  Values ride the MXU as hi+lo bf16 pairs
+    (exact to ~2^-17); out-of-tree rows (leaf -1 / padding) emit 0.
+    """
+    return _route_call(bins_t, leaf2, feature, threshold, default_left,
+                       is_categorical, cat_mask, sel, new_id, missing_types,
+                       nan_bins, default_bins, feat_group, feat_offset,
+                       num_bins, leaf_values, row_tile, interpret)
+
+
+def _route_call(bins_t, leaf2, feature, threshold, default_left,
+                is_categorical, cat_mask, sel, new_id, missing_types,
+                nan_bins, default_bins, feat_group, feat_offset, num_bins,
+                leaf_values, row_tile, interpret):
+    """Shared table/spec construction for both route entry points."""
     G_pad, n_pad = bins_t.shape
     L = feature.shape[0]
     B = cat_mask.shape[1]
     T = row_tile
     assert n_pad % T == 0
     L_pad = _round_up(max(L, 8), LANE)
+    with_values = leaf_values is not None
 
     tabs = _leaf_tables(feature, threshold, default_left, is_categorical,
                         sel, new_id, missing_types, nan_bins, default_bins,
-                        feat_group, feat_offset, num_bins, L_pad)
+                        feat_group, feat_offset, num_bins, L_pad,
+                        leaf_values=leaf_values)
     cat = jnp.zeros((B, L_pad), jnp.float32)
     cat = cat.at[:, :L].set(cat_mask.T.astype(jnp.float32))
 
-    return pl.pallas_call(
-        functools.partial(_route_kernel, B=B),
+    in_specs = [
+        pl.BlockSpec((G_pad, T), lambda r: (0, r),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((2, T), lambda r: (0, r),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((_T_ROWS, L_pad), lambda r: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((B, L_pad), lambda r: (0, 0),
+                     memory_space=pltpu.VMEM),
+    ]
+    leaf2_spec = pl.BlockSpec((2, T), lambda r: (0, r),
+                              memory_space=pltpu.VMEM)
+    tab_prec = table_precision(L_pad, G_pad)
+    if not with_values:
+        return pl.pallas_call(
+            functools.partial(_route_kernel, B=B, tab_prec=tab_prec),
+            grid=(n_pad // T,),
+            in_specs=in_specs,
+            out_specs=leaf2_spec,
+            out_shape=jax.ShapeDtypeStruct((2, n_pad), jnp.int32),
+            interpret=interpret,
+        )(bins_t, leaf2, tabs, cat)
+
+    leaf2_new, vals = pl.pallas_call(
+        functools.partial(_route_values_kernel, B=B, tab_prec=tab_prec),
         grid=(n_pad // T,),
-        in_specs=[
-            pl.BlockSpec((G_pad, T), lambda r: (0, r),
+        in_specs=in_specs,
+        out_specs=(
+            leaf2_spec,
+            pl.BlockSpec((1, T), lambda r: (0, r),
                          memory_space=pltpu.VMEM),
-            pl.BlockSpec((2, T), lambda r: (0, r),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((_T_ROWS, L_pad), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((B, L_pad), lambda r: (0, 0),
-                         memory_space=pltpu.VMEM),
-        ],
-        out_specs=pl.BlockSpec((2, T), lambda r: (0, r),
-                               memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((2, n_pad), jnp.int32),
+        ),
+        out_shape=(
+            jax.ShapeDtypeStruct((2, n_pad), jnp.int32),
+            jax.ShapeDtypeStruct((1, n_pad), jnp.float32),
+        ),
         interpret=interpret,
     )(bins_t, leaf2, tabs, cat)
+    return leaf2_new, vals[0]
 
 
 def route_rows_xla(bins: jnp.ndarray,

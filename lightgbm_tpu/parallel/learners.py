@@ -299,7 +299,8 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
         feature=P(), threshold_bin=P(), default_left=P(), is_categorical=P(),
         cat_mask=P(), left_child=P(), right_child=P(), gain=P(),
         internal_value=P(), internal_count=P(), leaf_value=P(),
-        leaf_count=P(), leaf_depth=P(), num_leaves=P(), row_leaf=vec)
+        leaf_count=P(), leaf_depth=P(), num_leaves=P(), row_leaf=vec,
+        row_value=P())   # distributed path scores via gather (empty [0])
 
     in_specs = (vec, P(), P(), P(), P(), P(), P(), P(), P(),
                 vec, vec, vec, P())

@@ -152,3 +152,16 @@ def test_route_kernel_matches_xla():
     np.testing.assert_array_equal(out_p[:, :n], out_x[:, :n])
     # hist_leaf stays parked at -1 for bagged-out rows
     assert (out_p[1, :n][hist_leaf < 0] == -1).all()
+
+    # the values-emitting variant: same routing + per-row leaf values
+    # selected by the POST-route leaf (the score-update gather replacement)
+    from lightgbm_tpu.ops.pallas_route import route_rows_values_pallas
+    leaf_values = rng.normal(scale=0.3, size=L).astype(np.float32)
+    out_v, vals = route_rows_values_pallas(
+        bt, leaf2, *args, jnp.asarray(leaf_values), interpret=True)
+    out_v, vals = np.asarray(out_v), np.asarray(vals)
+    np.testing.assert_array_equal(out_v[:, :n], out_x[:, :n])
+    expect = leaf_values[out_x[0, :n]]
+    np.testing.assert_allclose(vals[:n], expect, rtol=0, atol=2e-5)
+    # padding rows (leaf -1) emit exactly 0
+    assert (vals[n:] == 0.0).all()

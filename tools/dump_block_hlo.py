@@ -1,0 +1,20 @@
+import numpy as np, jax, jax.numpy as jnp, re, sys
+n = 1_000_000; leaves = 255; max_bin = 63
+rng = np.random.RandomState(0)
+X = rng.normal(size=(n, 28)).astype(np.float32)
+y = (X[:, 0]*2 + X[:, 1] - X[:, 2] + rng.normal(size=n) > 0).astype(np.float32)
+import lightgbm_tpu as lgb
+ds = lgb.Dataset(X, label=y, params={"max_bin": max_bin}); ds.construct()
+del X
+params = {"objective": "binary", "num_leaves": leaves, "max_bin": max_bin,
+          "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1}
+from lightgbm_tpu.basic import Booster
+bst = Booster(params=params, train_set=ds)
+g = bst._gbdt
+fn = g._block_fn(4)
+lowered = fn.lower(g.scores, jnp.float32(0.1))
+comp = lowered.compile()
+txt = comp.as_text()
+with open("/tmp/block_hlo.txt", "w") as f:
+    f.write(txt)
+print("dumped", len(txt), "chars")

@@ -1,0 +1,22 @@
+import os, sys, time, glob, gzip, json, collections
+import numpy as np, jax, jax.numpy as jnp
+
+n = 1_000_000; leaves = 255; max_bin = int(sys.argv[1]) if len(sys.argv) > 1 else 63
+rng = np.random.RandomState(0)
+X = rng.normal(size=(n, 28)).astype(np.float32)
+y = (X[:, 0]*2 + X[:, 1] - X[:, 2] + rng.normal(size=n) > 0).astype(np.float32)
+import lightgbm_tpu as lgb
+ds = lgb.Dataset(X, label=y, params={"max_bin": max_bin}); ds.construct()
+del X
+params = {"objective": "binary", "num_leaves": leaves, "max_bin": max_bin,
+          "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1}
+from lightgbm_tpu.basic import Booster
+bst = Booster(params=params, train_set=ds)
+bst.update()
+bst._gbdt.train_block(4)
+jax.block_until_ready(bst._gbdt.scores)
+os.makedirs(f"/tmp/jtrace{max_bin}", exist_ok=True)
+with jax.profiler.trace(f"/tmp/jtrace{max_bin}"):
+    bst._gbdt.train_block(4)
+    jax.block_until_ready(bst._gbdt.scores)
+print("trace done")

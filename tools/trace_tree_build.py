@@ -1,0 +1,27 @@
+import os, time, glob, gzip, json, collections
+import numpy as np, jax, jax.numpy as jnp
+
+n = 1_000_000; leaves = 255; max_bin = 63; f = 28
+rng = np.random.RandomState(0)
+X = rng.normal(size=(n, f)).astype(np.float32)
+y = (X[:, 0]*2 + X[:, 1] - X[:, 2] + rng.normal(size=n) > 0).astype(np.float32)
+import lightgbm_tpu as lgb
+ds = lgb.Dataset(X, label=y, params={"max_bin": max_bin}); ds.construct()
+from lightgbm_tpu.io.device import to_device
+dd = to_device(ds._constructed); del X
+from lightgbm_tpu.learner.serial import GrowthParams, build_tree
+from lightgbm_tpu.ops.pallas_histogram import transpose_bins
+from lightgbm_tpu.ops.split import SplitParams
+params = GrowthParams(num_leaves=leaves, split=SplitParams(min_data_in_leaf=20))
+grad = jnp.asarray(rng.normal(size=n).astype(np.float32))
+hess = jnp.asarray(rng.uniform(0.1, 0.3, size=n).astype(np.float32))
+bins_t = jax.jit(transpose_bins)(dd.bins)
+bt = jax.jit(lambda g, h: build_tree(dd, g, h, params, bins_t=bins_t))
+r = bt(grad, hess); jax.block_until_ready(r.leaf_value)
+
+os.makedirs("/tmp/jtrace", exist_ok=True)
+with jax.profiler.trace("/tmp/jtrace", create_perfetto_trace=True):
+    for _ in range(3):
+        r = bt(grad, hess)
+    jax.block_until_ready(r.leaf_value)
+print("trace done")
