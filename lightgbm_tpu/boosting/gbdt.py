@@ -18,6 +18,7 @@ host ``Tree`` (numpy) for the model file.
 from __future__ import annotations
 
 import math
+import os as _os
 import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -891,11 +892,22 @@ class GBDT:
             idx = list(range(k, T, K))
             # mask width +2: the sentinel miss bin must index an
             # always-False slot (never clamp onto a real bin)
-            sub = stack_trees([self.models[i] for i in idx],
-                              max_bins=dd.max_bins + 2)
-            out[:, k] += np.asarray(predict_binned(
-                sub, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types,
-                **self._bundle_kw(dd)))
+            # tree-CHUNKED walk: one vmapped pass over hundreds of
+            # 255-leaf trees at 6-figure row counts faults the TPU
+            # worker (the [T, n] walk state and its per-level gather
+            # temporaries); fixed power-of-two chunks bound the footprint
+            # and reuse at most two compiled programs
+            chunk = int(_os.environ.get("LGBM_TPU_PRED_TREE_CHUNK", 128))
+            # one leaf-axis size across chunks => one compiled program
+            pad_l = max(self.models[i].num_leaves for i in idx)
+            for s in range(0, len(idx), chunk):
+                part = idx[s:s + chunk]
+                sub = stack_trees([self.models[i] for i in part],
+                                  max_bins=dd.max_bins + 2,
+                                  pad_leaves=pad_l)
+                out[:, k] += np.asarray(predict_binned(
+                    sub, dd.bins, dd.nan_bins, dd.default_bins,
+                    dd.missing_types, **self._bundle_kw(dd)))
         return out if K > 1 else out[:, 0]
 
     def _predict_raw_early_stop(self, dd, n: int, K: int, T: int) -> np.ndarray:

@@ -448,9 +448,6 @@ class Config:
         # accepted-but-inert knobs must warn loudly, not silently no-op
         # (reference knobs that have no TPU counterpart)
         from .utils.log import log_warning
-        if self.use_two_round_loading:
-            log_warning("use_two_round_loading has no effect: the TPU "
-                        "loader streams once into the HBM binned matrix")
         if self.extra.get("gpu_platform_id") is not None or \
                 self.extra.get("gpu_device_id") is not None:
             log_warning("gpu_platform_id/gpu_device_id have no effect: "

@@ -109,33 +109,14 @@ def parse_file(path: str, config: Config
                                 dtype=np.float64)
         if raw.ndim == 1:
             raw = raw.reshape(-1, 1)
-        ncol = raw.shape[1]
-        label_idx = (_parse_column_spec(config.label_column, header_names)
-                     if config.label_column else 0)
-        drop = {label_idx}
-        if config.weight_column:
-            wi = _parse_column_spec(config.weight_column, header_names)
-            weight_inline = raw[:, wi].astype(np.float32)
-            drop.add(wi)
-        if config.group_column:
-            qi = _parse_column_spec(config.group_column, header_names)
-            query_inline = raw[:, qi]
-            drop.add(qi)
-        for ig in _parse_multi_spec(config.ignore_column, header_names):
-            drop.add(ig)
-        keep = [i for i in range(ncol) if i not in drop]
+        label_idx, weight_idx, query_idx, keep, feature_names, cat_cols = \
+            _column_plan(raw.shape[1], config, header_names)
+        if weight_idx is not None:
+            weight_inline = raw[:, weight_idx].astype(np.float32)
+        if query_idx is not None:
+            query_inline = raw[:, query_idx]
         label = raw[:, label_idx].astype(np.float32)
         X = raw[:, keep]
-        if header_names:
-            feature_names = [header_names[i] for i in keep]
-        else:
-            feature_names = [f"Column_{i}" for i in range(len(keep))]
-        cat_spec = config.categorical_column
-        cat_cols = []
-        if cat_spec:
-            cat_orig = _parse_multi_spec(cat_spec, header_names)
-            remap = {orig: j for j, orig in enumerate(keep)}
-            cat_cols = [remap[c] for c in cat_orig if c in remap]
     from ..utils.file_io import release
     release(path)                       # free the localized copy now
     return X, label, weight_inline, query_inline, feature_names, cat_cols
@@ -168,6 +149,164 @@ def _parse_libsvm(path: str, skip: int) -> Tuple[np.ndarray, np.ndarray]:
         for idx, v in feats:
             X[r, idx] = v
     return X, np.asarray(labels, np.float32)
+
+
+def _column_plan(ncol: int, config: Config, header_names):
+    """Row-independent column bookkeeping for delimited files (label /
+    weight / query / ignore / categorical columns), shared by the
+    in-memory and two-round paths."""
+    label_idx = (_parse_column_spec(config.label_column, header_names)
+                 if config.label_column else 0)
+    drop = {label_idx}
+    weight_idx = query_idx = None
+    if config.weight_column:
+        weight_idx = _parse_column_spec(config.weight_column, header_names)
+        drop.add(weight_idx)
+    if config.group_column:
+        query_idx = _parse_column_spec(config.group_column, header_names)
+        drop.add(query_idx)
+    for ig in _parse_multi_spec(config.ignore_column, header_names):
+        drop.add(ig)
+    keep = [i for i in range(ncol) if i not in drop]
+    if header_names:
+        names = [header_names[i] for i in keep]
+    else:
+        names = [f"Column_{i}" for i in range(len(keep))]
+    cat_cols = []
+    if config.categorical_column:
+        cat_orig = _parse_multi_spec(config.categorical_column, header_names)
+        remap = {orig: j for j, orig in enumerate(keep)}
+        cat_cols = [remap[c] for c in cat_orig if c in remap]
+    return label_idx, weight_idx, query_idx, keep, names, cat_cols
+
+
+def load_file_two_round(path: str, config: Config) -> "BinnedDataset":
+    """Two-round low-memory ingest (reference `dataset_loader.cpp:698-742`
+    + `utils/pipeline_reader.h:26+`): round 1 streams bounded chunks to
+    collect the bin-finding sample (row count via a raw newline scan, so
+    the sample indices MATCH the in-memory path's RNG draw — byte-
+    identical mappers); round 2 streams again, binning each chunk
+    straight into the packed uint16 column store.  Peak memory is the
+    binned matrix plus one chunk — the raw float64 matrix (8 bytes/cell)
+    never exists.
+    """
+    from .. import native
+    path = localize(path)
+    fmt = detect_format(path, config.has_header)
+    sep = {"csv": ",", "tsv": "\t"}[fmt]
+    header_names = None
+    skip = 0
+    if config.has_header:
+        with open(path) as f:
+            header_names = f.readline().rstrip("\n").split(sep)
+        skip = 1
+
+    # round 0: data row count via a raw scan (no parsing; bounded reads).
+    # Blank lines are NOT rows — the chunk parser skips them, and the
+    # count must match or the sample-index draw shifts.
+    n = 0
+    pending = False          # current line has non-whitespace content
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(4 << 20)
+            if not chunk:
+                break
+            filtered = chunk.translate(None, delete=b"\r \t")
+            arr = np.frombuffer(filtered, np.uint8)
+            nls = np.flatnonzero(arr == 10)
+            if len(nls):
+                gaps = np.diff(np.concatenate([[-1], nls])) > 1
+                if nls[0] == 0 and pending:
+                    gaps[0] = True       # line continued from prior chunk
+                n += int(gaps.sum())
+                pending = bool(len(arr) - 1 - nls[-1] > 0)
+            else:
+                pending = pending or len(arr) > 0
+    if pending:
+        n += 1                          # unterminated final line
+    n -= skip
+    if n <= 0:
+        raise ValueError(f"no data rows in {path!r}")
+
+    # the same sample-index draw as BinnedDataset.from_raw
+    sample_cnt = min(n, config.bin_construct_sample_cnt)
+    rng = np.random.RandomState(config.data_random_seed)
+    sample_idx = (np.arange(n) if sample_cnt >= n
+                  else np.sort(rng.choice(n, sample_cnt, replace=False)))
+
+    # round 1: stream chunks, keep only sampled rows
+    chunk_bytes = 4 << 20                  # bounded: ~4 MB text per chunk
+    sample_rows = []
+    base = 0
+    plan = None
+    for chunk in native.parse_delimited_chunks(path, sep, skip,
+                                               chunk_bytes=chunk_bytes):
+        if plan is None:
+            plan = _column_plan(chunk.shape[1], config, header_names)
+        lo = np.searchsorted(sample_idx, base)
+        hi = np.searchsorted(sample_idx, base + len(chunk))
+        if hi > lo:
+            sample_rows.append(chunk[sample_idx[lo:hi] - base])
+        base += len(chunk)
+    if base != n:
+        raise ValueError(
+            f"chunked parse saw {base} rows, newline scan counted {n}")
+    label_idx, weight_idx, query_idx, keep, names, cat_cols = plan
+    sample = np.concatenate(sample_rows)[:, keep]
+
+    from .dataset import BinnedDataset, find_mappers_from_sample
+    mappers = find_mappers_from_sample(sample, config, set(cat_cols))
+    del sample, sample_rows
+    used = [f for f in range(len(keep)) if not mappers[f].is_trivial]
+
+    # round 2: bin each chunk straight into the column store, using the
+    # SAME dtype _pack_columns would choose so the matrix can be adopted
+    # without a copy when EFB doesn't engage
+    max_nb = max((mappers[f].num_bin for f in used), default=2)
+    prebinned = np.zeros((n, len(used)),
+                         np.uint8 if max_nb <= 256 else np.int32)
+    label = np.zeros(n, np.float32)
+    weight = np.zeros(n, np.float32) if weight_idx is not None else None
+    query = np.zeros(n, np.float64) if query_idx is not None else None
+    base = 0
+    for chunk in native.parse_delimited_chunks(path, sep, skip,
+                                               chunk_bytes=chunk_bytes):
+        m = len(chunk)
+        label[base:base + m] = chunk[:, label_idx]
+        if weight is not None:
+            weight[base:base + m] = chunk[:, weight_idx]
+        if query is not None:
+            query[base:base + m] = chunk[:, query_idx]
+        for j, f in enumerate(used):
+            prebinned[base:base + m, j] = mappers[f].value_to_bin(
+                chunk[:, keep[f]])
+        base += m
+    from ..utils.file_io import release
+    release(path)
+
+    md = Metadata()
+    md.set_field("label", label)
+    if weight is not None:
+        md.set_field("weight", weight)
+    if query is not None:
+        change = np.nonzero(np.diff(query))[0] + 1
+        boundaries = np.concatenate([[0], change, [len(query)]])
+        md.query_boundaries = boundaries.astype(np.int32)
+
+    ds = BinnedDataset()
+    ds.config = config
+    ds.num_total_features = len(keep)
+    ds.feature_names = names
+    ds.mappers = mappers
+    ds.used_features = used
+    cols = [prebinned[:, j] for j in range(len(used))]
+    empty_X = np.zeros((n, 0))
+    ds = BinnedDataset._finish_from_mappers(ds, empty_X, config, md, n,
+                                            len(keep), cols=cols,
+                                            packed=prebinned)
+    log_info(f"two-round loading: {n} rows streamed, peak holds the "
+             f"binned store only")
+    return ds
 
 
 def load_raw_matrix(path: str, has_header: bool = False
@@ -219,6 +358,43 @@ def load_file(path: str, config: Config,
             and os.path.getmtime(bin_path) >= os.path.getmtime(path)):
         log_info(f"loading binary cache {bin_path}")
         return BinnedDataset.load_binary(bin_path)
+
+    # two-round / low-memory loading (use_two_round_loading): stream the
+    # file in bounded chunks, never materializing the raw float matrix
+    # (reference dataset_loader.cpp:698-742; HIGGS peak-RAM contract,
+    # docs/Experiments.rst:156-160)
+    if config.use_two_round_loading:
+        if reference is not None or num_machines > 1:
+            log_warning("use_two_round_loading is ignored for aligned "
+                        "valid sets and distributed loading; using the "
+                        "in-memory path")
+        else:
+            from .. import native
+            from ..utils.file_io import release
+            local = localize(path)      # ONE download; reused below
+            fmt = detect_format(local, config.has_header)
+            if fmt in ("csv", "tsv") and native.available():
+                try:
+                    ds = load_file_two_round(local, config)
+                finally:
+                    release(local)
+                w2 = _load_side_file(path + ".weight")
+                if w2 is not None:
+                    ds.metadata.set_field("weight", w2)
+                init2 = _load_side_file(path + ".init", np.float64)
+                if init2 is not None:
+                    ds.metadata.set_field("init_score", init2)
+                q2 = _load_side_file(path + ".query", np.int64)
+                if q2 is not None:
+                    ds.metadata.set_field("group", q2.astype(np.int32))
+                if config.is_save_binary_file and is_local:
+                    ds.save_binary(bin_path[:-4])
+                    log_info(f"saved binary cache {bin_path}")
+                return ds
+            release(local)
+            log_warning("use_two_round_loading needs the native parser "
+                        "and a CSV/TSV file; falling back to in-memory "
+                        "loading")
 
     X, label, weight, query_inline, feature_names, cat_cols = \
         parse_file(path, config)
@@ -289,7 +465,10 @@ def load_file(path: str, config: Config,
             cat_cols = [c for c in cat_cols if c < len(mappers)]
     ds = BinnedDataset.from_raw(X, config, categorical_features=cat_cols,
                                 feature_names=feature_names, metadata=md,
-                                mappers=mappers)
+                                mappers=mappers,
+                                bundle_allgather=(allgather if mappers
+                                                  is not None else None),
+                                rank=rank)
     if config.is_save_binary_file:
         ds.save_binary(bin_path[:-4])
         log_info(f"saved binary cache {bin_path}")

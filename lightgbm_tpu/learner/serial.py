@@ -236,7 +236,7 @@ def make_route_fn(data: DeviceData, backend: str,
                 best.default_left, best.is_categorical, best.cat_mask,
                 sel, new_id, data.missing_types, data.nan_bins,
                 data.default_bins, data.feat_group, data.feat_offset,
-                data.num_bins)
+                data.num_bins, any_cat=data.has_categorical)
     else:
         def route_impl(leaf2, best: SplitResult, sel, new_id):
             return route_rows_xla(
@@ -292,7 +292,7 @@ def make_fused_fn(data: DeviceData, grad, hess, hist_mode: str,
             data.missing_types, data.nan_bins, data.default_bins,
             data.feat_group, data.feat_offset, data.num_bins,
             num_features=data.num_groups, max_bins=data.group_max_bins,
-            mode=hist_mode)
+            mode=hist_mode, any_cat=data.has_categorical)
         return h, leaf2_new
     return fused
 
@@ -449,7 +449,8 @@ def build_tree(data: DeviceData,
             final.best.default_left, final.best.is_categorical,
             final.best.cat_mask, final.pend_sel, final.pend_new,
             data.missing_types, data.nan_bins, data.default_bins,
-            data.feat_group, data.feat_offset, data.num_bins, lv_final)
+            data.feat_group, data.feat_offset, data.num_bins, lv_final,
+            any_cat=data.has_categorical)
         row_value = row_value[:n]
     else:
         leaf2_final = route_fn(final.leaf2, final.best, final.pend_sel,

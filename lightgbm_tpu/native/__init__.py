@@ -54,6 +54,13 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
             ctypes.POINTER(ctypes.c_long),
             ctypes.POINTER(ctypes.POINTER(ctypes.c_double))]
+        lib.ltpu_parse_delimited_chunk.restype = ctypes.c_long
+        lib.ltpu_parse_delimited_chunk.argtypes = [
+            ctypes.c_char_p, ctypes.c_char, ctypes.c_longlong,
+            ctypes.c_long, ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_long),
+            ctypes.POINTER(ctypes.c_longlong)]
         lib.ltpu_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
         _lib = lib
     except Exception:
@@ -88,6 +95,41 @@ def parse_delimited(path: str, delim: str, skip: int) -> Optional[np.ndarray]:
     if rows == 0 or cols.value == 0:
         return np.zeros((0, max(cols.value, 0)), np.float64)
     return _take(lib, data, (int(rows), int(cols.value)))
+
+
+def parse_delimited_chunks(path: str, delim: str, skip: int,
+                           chunk_bytes: int = 8 << 20):
+    """Generator of bounded-memory ``[rows, cols]`` float64 chunks
+    (two-round / pipelined loading, the `pipeline_reader.h:26+` pattern).
+    Yields nothing when the native parser is unavailable — callers must
+    check :func:`available` first."""
+    lib = _load()
+    if lib is None:
+        return
+    offset = 0
+    expect_cols = -1
+    size = os.path.getsize(path)
+    while offset < size:
+        data = ctypes.POINTER(ctypes.c_double)()
+        cols = ctypes.c_long()
+        nxt = ctypes.c_longlong()
+        rows = lib.ltpu_parse_delimited_chunk(
+            path.encode(), delim.encode(), offset, skip, chunk_bytes,
+            expect_cols, ctypes.byref(data), ctypes.byref(cols),
+            ctypes.byref(nxt))
+        if rows == -4:
+            # a single row longer than the chunk: grow and retry
+            chunk_bytes *= 4
+            continue
+        if rows < 0:
+            raise ValueError(
+                f"native chunked parse failed on {path!r} (code {rows})")
+        if rows > 0:
+            expect_cols = int(cols.value)
+            yield _take(lib, data, (int(rows), expect_cols))
+        if int(nxt.value) <= offset:
+            break
+        offset = int(nxt.value)
 
 
 def parse_libsvm(path: str, skip: int

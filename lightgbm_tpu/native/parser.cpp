@@ -35,6 +35,16 @@ char* read_file(const char* path, size_t* out_len) {
   return buf;
 }
 
+// Consume a blank (empty or whitespace-only) line at p; returns whether
+// one was consumed.  Blank lines are not rows (text_reader semantics).
+inline bool skip_blank_line(const char*& p, const char* end) {
+  const char* q = p;
+  while (q < end && (*q == ' ' || *q == '\t' || *q == '\r')) ++q;
+  if (q >= end) { p = q; return true; }
+  if (*q == '\n') { p = q + 1; return true; }
+  return false;
+}
+
 inline const char* skip_lines(const char* p, const char* end, long n) {
   while (n > 0 && p < end) {
     const char* nl = static_cast<const char*>(
@@ -112,6 +122,7 @@ long ltpu_parse_delimited(const char* path, char delim, long skip,
   long rows = 0;
   while (p < end) {
     if (*p == '\n' || *p == '\r') { ++p; continue; }
+    if (skip_blank_line(p, end)) continue;
     bool done = false;
     long c = 0;
     while (c < cols && !(done && c > 0)) {
@@ -193,6 +204,86 @@ long ltpu_parse_libsvm(const char* path, long skip, double** out_x,
   std::free(buf);
   *out_x = X;
   *out_labels = y;
+  *out_cols = cols;
+  return rows;
+}
+
+// Chunked delimited parse for two-round / low-memory loading (the
+// reference's pattern: utils/pipeline_reader.h bounded double-buffered
+// reads + dataset_loader.cpp:698-742 two-round flow).  Reads at most
+// `max_bytes` from `offset`, parses the COMPLETE rows in the buffer and
+// reports where the next chunk starts.  `skip` header lines are consumed
+// only when offset == 0.  `expect_cols` < 0 derives the column count
+// from the first data line (returned via *out_cols either way).
+// Returns rows parsed (0 at EOF), or <0: -1 open/seek failure,
+// -3 inconsistent columns, -4 a single row exceeds max_bytes.
+long ltpu_parse_delimited_chunk(const char* path, char delim,
+                                long long offset, long skip,
+                                long max_bytes, long expect_cols,
+                                double** out_data, long* out_cols,
+                                long long* out_next) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  if (std::fseek(f, static_cast<long>(offset), SEEK_SET) != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  char* buf = static_cast<char*>(std::malloc(static_cast<size_t>(max_bytes) + 1));
+  if (!buf) { std::fclose(f); return -2; }
+  size_t got = std::fread(buf, 1, static_cast<size_t>(max_bytes), f);
+  bool at_eof = (std::feof(f) != 0);
+  std::fclose(f);
+  buf[got] = '\0';
+
+  const char* end = buf + got;
+  // only parse up to the last complete line unless the file ends here
+  if (!at_eof) {
+    const char* last_nl = end;
+    while (last_nl > buf && last_nl[-1] != '\n') --last_nl;
+    if (last_nl == buf) { std::free(buf); return got ? -4 : 0; }
+    end = last_nl;
+  }
+
+  const char* p = buf;
+  if (offset == 0) p = skip_lines(p, end, skip);
+
+  long cols = expect_cols;
+  if (cols < 0) {
+    const char* q = p;
+    while (q < end && (*q == '\n' || *q == '\r')) ++q;
+    if (q >= end) { std::free(buf); *out_cols = 0; *out_next = offset + (end - buf); return 0; }
+    const char* scan = q;
+    bool done = false;
+    cols = 0;
+    while (!done && scan < end) {
+      parse_field(scan, end, delim, &done);
+      ++cols;
+    }
+  }
+
+  std::vector<double> data;
+  data.reserve(1 << 16);
+  long rows = 0;
+  while (p < end) {
+    if (*p == '\n' || *p == '\r') { ++p; continue; }
+    if (skip_blank_line(p, end)) continue;
+    bool done = false;
+    long c = 0;
+    while (c < cols && !(done && c > 0)) {
+      data.push_back(parse_field(p, end, delim, &done));
+      ++c;
+    }
+    if (c < cols || !done) { std::free(buf); return -3; }
+    ++rows;
+  }
+  *out_next = offset + (p - buf);
+  std::free(buf);
+
+  double* out = static_cast<double*>(std::malloc(
+      (data.empty() ? 1 : data.size()) * sizeof(double)));
+  if (!out) return -2;
+  std::memcpy(out, data.data(), data.size() * sizeof(double));
+  *out_data = out;
   *out_cols = cols;
   return rows;
 }

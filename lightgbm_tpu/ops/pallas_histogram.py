@@ -377,7 +377,7 @@ def default_backend() -> str:
 def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
                        cat_ref, out_ref, leaf2_out_ref, *,
                        n_cols: int, B: int, Bcat: int, pad_cols: int,
-                       tab_prec):
+                       tab_prec, any_cat: bool = True):
     """Apply the previous wave's pending splits to the leaf vectors, then
     histogram the active leaves — both from ONE VMEM-resident bins tile.
     The route logic matches ``ops/pallas_route.py`` (same table layout)."""
@@ -431,14 +431,18 @@ def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
     is_missing = jnp.where(
         ((mt == float(MISSING_NAN)) & (b == nanb))
         | ((mt == float(MISSING_ZERO)) & (b == db)), one, zero)
-    catrow = jnp.dot(cat_ref[:], ohL, preferred_element_type=jnp.float32)
-    iota_b = jax.lax.broadcasted_iota(
-        jnp.int32, (Bcat, T), 0).astype(jnp.float32)
-    cat_left = jnp.sum(jnp.where(iota_b == b, catrow, 0.0), axis=0,
-                       keepdims=True)
     le_thr = jnp.where(b <= thr, one, zero)
     num_left = jnp.where(is_missing > 0.5, dl, le_thr)
-    go_left = jnp.where(iscat > 0.5, cat_left, num_left)
+    if any_cat:
+        catrow = jnp.dot(cat_ref[:], ohL,
+                         preferred_element_type=jnp.float32)
+        iota_b = jax.lax.broadcasted_iota(
+            jnp.int32, (Bcat, T), 0).astype(jnp.float32)
+        cat_left = jnp.sum(jnp.where(iota_b == b, catrow, 0.0), axis=0,
+                           keepdims=True)
+        go_left = jnp.where(iscat > 0.5, cat_left, num_left)
+    else:
+        go_left = num_left
     in_tree = jnp.where(leaf >= 0, one, zero)
     moved = selm * (one - jnp.minimum(go_left, one)) * in_tree
     nid = new_id.astype(jnp.int32)
@@ -481,14 +485,14 @@ def fused_config_ok(num_groups: int, max_bins: int, num_leaves: int,
 @functools.partial(
     jax.jit,
     static_argnames=("num_features", "max_bins", "mode", "row_tile",
-                     "interpret"))
+                     "interpret", "any_cat"))
 def hist_route_pallas(bins_t, vals, leaf2, active,
                       feature, threshold, default_left, is_categorical,
                       cat_mask, sel, new_id, missing_types, nan_bins,
                       default_bins, feat_group, feat_offset, num_bins_arr,
                       *, num_features: int, max_bins: int,
                       mode: str = "hilo", row_tile: int = DEFAULT_ROW_TILE,
-                      interpret: bool = False):
+                      interpret: bool = False, any_cat: bool = True):
     """Fused previous-wave routing + active-leaf histograms.
 
     -> ``(hist [A, F, B, 3] f32, leaf2_new [2, n_pad] i32)``.  Same
@@ -528,7 +532,7 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
     from .pallas_route import table_precision
     out, leaf2_new = pl.pallas_call(
         functools.partial(_hist_route_kernel, n_cols=C, B=B, Bcat=Bcat,
-                          pad_cols=pad_cols,
+                          pad_cols=pad_cols, any_cat=any_cat,
                           tab_prec=table_precision(L_pad, F_pad)),
         grid=(n_pad // T,),
         in_specs=[

@@ -73,13 +73,14 @@ def table_precision(L_pad: int, num_groups: int):
 
 
 def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *,
-                  B: int, tab_prec=jax.lax.Precision.HIGHEST):
+                  B: int, tab_prec=jax.lax.Precision.HIGHEST,
+                  any_cat: bool = True):
     _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, B=B,
-                tab_prec=tab_prec)
+                tab_prec=tab_prec, any_cat=any_cat)
 
 
 def _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int,
-                tab_prec=jax.lax.Precision.HIGHEST):
+                tab_prec=jax.lax.Precision.HIGHEST, any_cat: bool = True):
     leaf = leaf2_ref[0:1, :]                                  # [1, T] i32
     T = leaf.shape[1]
     L_pad = tabs_ref.shape[1]
@@ -126,17 +127,21 @@ def _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int,
         ((mt == float(MISSING_NAN)) & (b == nanb))
         | ((mt == float(MISSING_ZERO)) & (b == db)), one, zero)
 
-    catrow = jnp.dot(cat_ref[:], ohL,
-                     preferred_element_type=jnp.float32)      # [B, T]
-    iota_b = jax.lax.broadcasted_iota(
-        jnp.int32, (B, T), 0).astype(jnp.float32)
-    cat_left = jnp.sum(
-        jnp.where(iota_b == b, catrow, 0.0), axis=0,
-        keepdims=True)                                        # [1, T]
-
     le_thr = jnp.where(b <= thr, one, zero)
     num_left = jnp.where(is_missing > 0.5, dl, le_thr)
-    go_left = jnp.where(iscat > 0.5, cat_left, num_left)
+    if any_cat:
+        catrow = jnp.dot(cat_ref[:], ohL,
+                         preferred_element_type=jnp.float32)  # [B, T]
+        iota_b = jax.lax.broadcasted_iota(
+            jnp.int32, (B, T), 0).astype(jnp.float32)
+        cat_left = jnp.sum(
+            jnp.where(iota_b == b, catrow, 0.0), axis=0,
+            keepdims=True)                                    # [1, T]
+        go_left = jnp.where(iscat > 0.5, cat_left, num_left)
+    else:
+        # no categorical features in the dataset: skip the [B, L] @
+        # [L, T] membership dot + bin one-hot reduction entirely
+        go_left = num_left
     in_tree = jnp.where(leaf >= 0, one, zero)
     moved = selm * (one - jnp.minimum(go_left, one)) * in_tree
     nid = new_id.astype(jnp.int32)
@@ -150,14 +155,15 @@ def _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int,
 
 def _route_values_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref,
                          val_ref, *, B: int,
-                         tab_prec=jax.lax.Precision.HIGHEST):
+                         tab_prec=jax.lax.Precision.HIGHEST,
+                         any_cat: bool = True):
     """Route + emit each row's POST-route leaf value (final tree pass).
 
     The value rides the tabs as a hi+lo bf16 pair selected by a second
     leaf one-hot built from the routed ids; rows outside the tree
     (leaf -1, padding) emit 0."""
     rl = _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, B=B,
-                     tab_prec=tab_prec)
+                     tab_prec=tab_prec, any_cat=any_cat)
     T = rl.shape[1]
     L_pad = tabs_ref.shape[1]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
@@ -194,7 +200,7 @@ def _leaf_tables(feature, threshold, default_left, is_categorical, sel,
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("row_tile", "interpret"))
+                   static_argnames=("row_tile", "interpret", "any_cat"))
 def route_rows_pallas(bins_t: jnp.ndarray,
                       leaf2: jnp.ndarray,
                       feature: jnp.ndarray,
@@ -212,7 +218,8 @@ def route_rows_pallas(bins_t: jnp.ndarray,
                       num_bins: jnp.ndarray,
                       *,
                       row_tile: int = DEFAULT_ROW_TILE,
-                      interpret: bool = False) -> jnp.ndarray:
+                      interpret: bool = False,
+                      any_cat: bool = True) -> jnp.ndarray:
     """Apply this wave's splits to both leaf vectors: ``-> [2, n_pad]``.
 
     Args:
@@ -232,11 +239,11 @@ def route_rows_pallas(bins_t: jnp.ndarray,
     return _route_call(bins_t, leaf2, feature, threshold, default_left,
                        is_categorical, cat_mask, sel, new_id, missing_types,
                        nan_bins, default_bins, feat_group, feat_offset,
-                       num_bins, None, row_tile, interpret)
+                       num_bins, None, row_tile, interpret, any_cat)
 
 
 @functools.partial(jax.jit,
-                   static_argnames=("row_tile", "interpret"))
+                   static_argnames=("row_tile", "interpret", "any_cat"))
 def route_rows_values_pallas(bins_t: jnp.ndarray,
                              leaf2: jnp.ndarray,
                              feature: jnp.ndarray,
@@ -255,7 +262,8 @@ def route_rows_values_pallas(bins_t: jnp.ndarray,
                              leaf_values: jnp.ndarray,
                              *,
                              row_tile: int = DEFAULT_ROW_TILE,
-                             interpret: bool = False):
+                             interpret: bool = False,
+                             any_cat: bool = True):
     """Final per-tree route: apply pending splits AND emit each row's
     leaf value — ``-> (leaf2 [2, n_pad] i32, values [n_pad] f32)``.
 
@@ -267,13 +275,13 @@ def route_rows_values_pallas(bins_t: jnp.ndarray,
     return _route_call(bins_t, leaf2, feature, threshold, default_left,
                        is_categorical, cat_mask, sel, new_id, missing_types,
                        nan_bins, default_bins, feat_group, feat_offset,
-                       num_bins, leaf_values, row_tile, interpret)
+                       num_bins, leaf_values, row_tile, interpret, any_cat)
 
 
 def _route_call(bins_t, leaf2, feature, threshold, default_left,
                 is_categorical, cat_mask, sel, new_id, missing_types,
                 nan_bins, default_bins, feat_group, feat_offset, num_bins,
-                leaf_values, row_tile, interpret):
+                leaf_values, row_tile, interpret, any_cat=True):
     """Shared table/spec construction for both route entry points."""
     G_pad, n_pad = bins_t.shape
     L = feature.shape[0]
@@ -305,7 +313,8 @@ def _route_call(bins_t, leaf2, feature, threshold, default_left,
     tab_prec = table_precision(L_pad, G_pad)
     if not with_values:
         return pl.pallas_call(
-            functools.partial(_route_kernel, B=B, tab_prec=tab_prec),
+            functools.partial(_route_kernel, B=B, tab_prec=tab_prec,
+                              any_cat=any_cat),
             grid=(n_pad // T,),
             in_specs=in_specs,
             out_specs=leaf2_spec,
@@ -314,7 +323,8 @@ def _route_call(bins_t, leaf2, feature, threshold, default_left,
         )(bins_t, leaf2, tabs, cat)
 
     leaf2_new, vals = pl.pallas_call(
-        functools.partial(_route_values_kernel, B=B, tab_prec=tab_prec),
+        functools.partial(_route_values_kernel, B=B, tab_prec=tab_prec,
+                          any_cat=any_cat),
         grid=(n_pad // T,),
         in_specs=in_specs,
         out_specs=(

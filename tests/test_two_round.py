@@ -1,0 +1,137 @@
+"""Two-round / low-memory loading (VERDICT r2 #7).
+
+Reference: `dataset_loader.cpp:698-742` (two-round flow),
+`utils/pipeline_reader.h:26+` (bounded buffered reads), and the
+HIGGS peak-RAM claim that rests on it (`docs/Experiments.rst:156-160`).
+"""
+import os
+import tracemalloc
+
+import numpy as np
+import pytest
+
+from lightgbm_tpu import native
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.loader import load_file, load_file_two_round
+
+pytestmark = pytest.mark.skipif(not native.available(),
+                                reason="native parser unavailable")
+
+
+def _write(path, n, F, seed=0, sep=",", weight_col=False):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, F))
+    X[rng.rand(n, F) < 0.05] = np.nan          # missing fields
+    y = (X[:, 0] > 0).astype(np.float32)
+    cols = [y] + [X[:, j] for j in range(F)]
+    if weight_col:
+        cols.append(rng.uniform(0.5, 2.0, size=n))
+    np.savetxt(path, np.column_stack(cols), delimiter=sep, fmt="%.6f")
+    return X, y
+
+
+def test_chunked_parse_matches_whole_file(tmp_path):
+    path = tmp_path / "d.csv"
+    _write(path, 5003, 6, seed=1)
+    whole = native.parse_delimited(str(path), ",", 0)
+    chunks = list(native.parse_delimited_chunks(str(path), ",", 0,
+                                                chunk_bytes=64 << 10))
+    assert len(chunks) > 1                     # actually chunked
+    stitched = np.concatenate(chunks)
+    np.testing.assert_array_equal(np.isnan(whole), np.isnan(stitched))
+    np.testing.assert_allclose(np.nan_to_num(whole),
+                               np.nan_to_num(stitched))
+
+
+def test_two_round_equals_one_round(tmp_path):
+    """Same file, same config: the streamed path must produce the
+    byte-identical binned dataset (same RNG sample draw -> same
+    mappers -> same bins)."""
+    path = tmp_path / "t.csv"
+    _write(path, 8000, 8, seed=2)
+    cfg1 = Config.from_params({"max_bin": 63})
+    one = load_file(str(path), cfg1)
+    cfg2 = Config.from_params({"max_bin": 63,
+                               "use_two_round_loading": True})
+    two = load_file(str(path), cfg2)
+
+    assert two.num_data == one.num_data
+    np.testing.assert_array_equal(one.bins, two.bins)
+    np.testing.assert_array_equal(one.feature_info.num_bins,
+                                  two.feature_info.num_bins)
+    for m1, m2 in zip(one.mappers, two.mappers):
+        d1, d2 = m1.to_dict(), m2.to_dict()
+        assert d1.keys() == d2.keys()
+        for k in d1:
+            if isinstance(d1[k], list):
+                np.testing.assert_array_equal(       # NaN-aware
+                    np.asarray(d1[k], np.float64),
+                    np.asarray(d2[k], np.float64))
+            else:
+                assert d1[k] == d2[k], k
+    np.testing.assert_allclose(one.metadata.label, two.metadata.label)
+
+
+def test_two_round_blank_lines(tmp_path):
+    """Blank lines are not rows: the raw row count must agree with the
+    parser's, or the sample draw shifts (review finding)."""
+    path = tmp_path / "blank.csv"
+    _write(path, 500, 3, seed=9)
+    text = path.read_text()
+    lines = text.splitlines()
+    # inject blank lines mid-file and at the end
+    lines.insert(100, "")
+    lines.insert(300, "   ")
+    doctored = "\n".join(lines) + "\n\n"
+    path.write_text(doctored)
+    cfg = Config.from_params({"max_bin": 31,
+                              "use_two_round_loading": True})
+    ds = load_file(str(path), cfg)
+    assert ds.num_data == 500
+
+
+def test_two_round_weight_column_and_side_file(tmp_path):
+    path = tmp_path / "w.tsv"
+    _write(path, 1000, 4, seed=3, sep="\t", weight_col=True)
+    cfg = Config.from_params({"max_bin": 31, "weight_column": "5",
+                              "use_two_round_loading": True})
+    ds = load_file(str(path), cfg)
+    assert ds.metadata.weight is not None
+    assert ds.metadata.weight.shape == (1000,)
+    assert ds.num_total_features == 4          # label + weight dropped
+
+
+def test_two_round_peak_memory_below_raw(tmp_path):
+    """The raw float64 matrix must never materialize: peak allocation
+    during the streamed load stays well under the raw-matrix size (the
+    reference's 0.868 GB HIGGS figure is exactly this property)."""
+    n, F = 120_000, 24
+    path = tmp_path / "big.csv"
+    _write(path, n, F, seed=4)
+    raw_bytes = n * (F + 1) * 8                # ~24 MB
+    # sample a fraction of rows, as any real big-file load does (at the
+    # default 200k sample cnt this 120k-row test file would be sampled
+    # in FULL, and the sample IS a raw matrix)
+    cfg = Config.from_params({"max_bin": 63, "bin_construct_sample_cnt": 20000,
+                              "use_two_round_loading": True})
+    tracemalloc.start()
+    ds = load_file_two_round(str(path), cfg)
+    _, peak = tracemalloc.get_traced_memory()
+    tracemalloc.stop()
+    assert ds.num_data == n
+    # binned store (2B staging + 1B packed) + one 8MB chunk + sample,
+    # far under the 24MB raw matrix
+    assert peak < 0.75 * raw_bytes, (peak, raw_bytes)
+
+
+def test_two_round_trains(tmp_path):
+    path = tmp_path / "train.csv"
+    X, y = _write(path, 4000, 6, seed=5)
+    import lightgbm_tpu as lgb
+    params = {"objective": "binary", "num_iterations": 8, "num_leaves": 15,
+              "two_round": True, "verbose": -1}
+    ds = lgb.Dataset(str(path), params=params)
+    bst = lgb.train(params, ds)
+    mask = ~np.isnan(X[:, 0])
+    acc = ((bst.predict(np.nan_to_num(X[mask])) > 0.5) == y[mask]).mean()
+    assert acc > 0.8, acc

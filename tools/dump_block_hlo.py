@@ -1,3 +1,5 @@
+import os as _os, sys as _sys
+_sys.path.insert(0, _os.path.dirname(_os.path.dirname(_os.path.abspath(__file__))))
 import numpy as np, jax, jax.numpy as jnp, re, sys
 n = 1_000_000; leaves = 255; max_bin = 63
 rng = np.random.RandomState(0)
