@@ -17,11 +17,12 @@ does over the reference's 500-iteration runs.
 
 Real data (VERDICT r2 #3): the throughput workload is synthetic (and
 labeled as such), but when real data is reachable the bench ALSO trains
-on it at full iteration count and reports a held-out eval metric in the
-same JSON line — by default the reference's own 7000-row
-binary_classification example (500 iterations, eval AUC on binary.test,
-`docs/Experiments.rst`-style), or any ``BENCH_DATA=train[,test]``
-CSV/TSV pair with label in column 0.
+on it and reports a held-out eval metric in the same JSON line — by
+default the reference's own 7000-row binary_classification example at
+its own train.conf settings (100 trees, bagging + feature_fraction;
+eval AUC on binary.test), or any ``BENCH_DATA=train[,test]`` CSV/TSV
+pair with label in column 0 (``BENCH_DATA_ITERS`` overrides the
+iteration count).
 """
 import json
 import os
@@ -34,13 +35,8 @@ REF_EXAMPLE = "/root/reference/examples/binary_classification"
 
 
 def _auc(y, s):
-    order = np.argsort(s, kind="stable")
-    ranks = np.empty(len(s))
-    ranks[order] = np.arange(1, len(s) + 1)
-    npos = y.sum()
-    nneg = len(y) - npos
-    return float((ranks[y > 0.5].sum() - npos * (npos + 1) / 2)
-                 / (npos * nneg))
+    from lightgbm_tpu.metric.metrics import binary_auc
+    return binary_auc(y, s)
 
 
 def real_data_eval():
@@ -73,9 +69,9 @@ def real_data_eval():
     t0 = time.time()
     bst = lgb.train(params, ds)
     wall = time.time() - t0
-    test = np.loadtxt(test_path)
-    yt, Xt = test[:, 0].astype(np.float32), test[:, 1:]
-    auc = _auc(yt, bst.predict(Xt, raw_score=True))
+    from lightgbm_tpu.io.loader import load_raw_matrix
+    Xt, yt = load_raw_matrix(test_path)     # format-autodetected
+    auc = _auc(yt.astype(np.float32), bst.predict(Xt, raw_score=True))
     return {"real_data": name, "real_data_iters": iters,
             "real_data_eval_auc": round(auc, 5),
             "real_data_train_s": round(wall, 1)}

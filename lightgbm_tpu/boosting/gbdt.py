@@ -675,6 +675,11 @@ class GBDT:
         from ..utils.timetag import phases_enabled
         if phases_enabled():
             return False
+        if _os.environ.get("LGBM_TPU_NO_BLOCK"):
+            # debug / watchdog escape hatch: slow backends (scatter at
+            # large n) can push a 32-iteration block past the device's
+            # dispatch watchdog; per-iteration dispatches stay short
+            return False
         c = self.config
         return (self.boosting_name == "gbdt"
                 and self.mesh_ctx is None
@@ -888,26 +893,40 @@ class GBDT:
             return out if K > 1 else out[:, 0]
         if self.config is not None and self.config.pred_early_stop:
             return self._predict_raw_early_stop(dd, n, K, T)
+        bundle_kw = self._bundle_kw(dd)
+        # the matmul predictor (predict_binned_matmul): every node
+        # decision at once + one path-agreement contraction — no gathers,
+        # no depth loop.  The gather walk serializes depth x trees x rows
+        # (minutes at 500 deep trees x 2e5 rows; long dispatches fault
+        # the TPU worker).  Gated: numerical splits, bin ids <= 256
+        # (bf16-exact through the MXU), unbundled columns.
+        use_matmul = (not bundle_kw
+                      and dd.max_bins + 2 <= 256
+                      and not any(self.models[i].num_cat > 0
+                                  for i in range(T)))
+        from ..models.tree import (build_path_matrices, predict_binned_matmul,
+                                   predict_binned_chunked)
+        tchunk = int(_os.environ.get("LGBM_TPU_PRED_TREE_CHUNK",
+                                     16 if use_matmul else 128))
+        rchunk = int(_os.environ.get("LGBM_TPU_PRED_ROW_CHUNK",
+                                     4096 if use_matmul else 1 << 16))
         for k in range(K):
             idx = list(range(k, T, K))
+            trees_k = [self.models[i] for i in idx]
             # mask width +2: the sentinel miss bin must index an
             # always-False slot (never clamp onto a real bin)
-            # tree-CHUNKED walk: one vmapped pass over hundreds of
-            # 255-leaf trees at 6-figure row counts faults the TPU
-            # worker (the [T, n] walk state and its per-level gather
-            # temporaries); fixed power-of-two chunks bound the footprint
-            # and reuse at most two compiled programs
-            chunk = int(_os.environ.get("LGBM_TPU_PRED_TREE_CHUNK", 128))
-            # one leaf-axis size across chunks => one compiled program
-            pad_l = max(self.models[i].num_leaves for i in idx)
-            for s in range(0, len(idx), chunk):
-                part = idx[s:s + chunk]
-                sub = stack_trees([self.models[i] for i in part],
-                                  max_bins=dd.max_bins + 2,
-                                  pad_leaves=pad_l)
-                out[:, k] += np.asarray(predict_binned(
+            sub = stack_trees(trees_k, max_bins=dd.max_bins + 2)
+            if use_matmul:
+                P, plen = build_path_matrices(trees_k)
+                out[:, k] += np.asarray(predict_binned_matmul(
+                    sub, jnp.asarray(P), jnp.asarray(plen), dd.bins,
+                    dd.nan_bins, dd.default_bins, dd.missing_types,
+                    tchunk=tchunk, rchunk=rchunk))
+            else:
+                out[:, k] += np.asarray(predict_binned_chunked(
                     sub, dd.bins, dd.nan_bins, dd.default_bins,
-                    dd.missing_types, **self._bundle_kw(dd)))
+                    dd.missing_types, tchunk=tchunk, rchunk=rchunk,
+                    **bundle_kw))
         return out if K > 1 else out[:, 0]
 
     def _predict_raw_early_stop(self, dd, n: int, K: int, T: int) -> np.ndarray:

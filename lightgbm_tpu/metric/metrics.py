@@ -165,6 +165,13 @@ class BinaryErrorMetric(Metric):
                                         weight), False)]
 
 
+def binary_auc(label, score, weight=None):
+    """Tie-aware rank-sum AUC — the shared helper behind AucMetric, the
+    bench gate, and the parity tooling."""
+    return AucMetric.__new__(AucMetric).eval(
+        np.asarray(label), np.asarray(score), weight)[0][1]
+
+
 class AucMetric(Metric):
     names = ("auc",)
     higher_better = True

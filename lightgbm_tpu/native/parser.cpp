@@ -279,12 +279,13 @@ long ltpu_parse_delimited_chunk(const char* path, char delim,
   *out_next = offset + (p - buf);
   std::free(buf);
 
+  *out_cols = cols;
+  if (rows == 0) return 0;     // nothing to hand out (caller won't free)
   double* out = static_cast<double*>(std::malloc(
-      (data.empty() ? 1 : data.size()) * sizeof(double)));
+      data.size() * sizeof(double)));
   if (!out) return -2;
   std::memcpy(out, data.data(), data.size() * sizeof(double));
   *out_data = out;
-  *out_cols = cols;
   return rows;
 }
 

@@ -1,0 +1,46 @@
+/* SWIG interface for the lightgbm_tpu C API — the JVM binding surface.
+ *
+ * The counterpart of the reference's `swig/lightgbmlib.i`: a thin SWIG
+ * export of the 51-function C API (lightgbm_tpu/capi/lightgbm_tpu_c.h)
+ * for Java hosts.  Generate + build (needs a JDK for jni.h/javac):
+ *
+ *   swig -java -package io.lightgbm_tpu -outdir java_out \
+ *        -o lightgbm_tpu_wrap.c swig/lightgbm_tpu_lib.i
+ *   g++ -O2 -shared -fPIC lightgbm_tpu_wrap.c \
+ *       lightgbm_tpu/capi/lightgbm_tpu_c.cpp \
+ *       -I$JAVA_HOME/include -I$JAVA_HOME/include/linux \
+ *       $(python3-config --includes --ldflags --embed) \
+ *       -o liblightgbm_tpu_swig.so
+ *
+ * tests/test_swig.py validates the interface generates cleanly with the
+ * in-image swig; the JNI compile needs a JDK, which this image lacks.
+ */
+%module lightgbm_tpulib
+
+%{
+#include "../lightgbm_tpu/capi/lightgbm_tpu_c.h"
+%}
+
+%include "typemaps.i"
+%include "various.i"
+%include "carrays.i"
+%include "cpointer.i"
+%include "stdint.i"
+
+/* handle pointers + common out-params, mirroring the reference's usage
+ * of pointer classes on the JVM side */
+%pointer_functions(int, intp)
+%pointer_functions(long, longp)
+%pointer_functions(double, doublep)
+%pointer_functions(float, floatp)
+%pointer_functions(int64_t, int64_tp)
+%pointer_functions(int32_t, int32_tp)
+%pointer_functions(void*, voidpp)
+
+/* array helpers for buffers crossing the JNI boundary */
+%array_functions(double, doubleArray)
+%array_functions(float, floatArray)
+%array_functions(int, intArray)
+%array_functions(long, longArray)
+
+%include "../lightgbm_tpu/capi/lightgbm_tpu_c.h"

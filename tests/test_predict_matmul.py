@@ -1,0 +1,78 @@
+"""Matmul predictor vs the gather-walk oracle.
+
+The TPU-native predictor (`models/tree.py predict_binned_matmul`)
+evaluates every node decision at once and selects the leaf by a
+path-agreement contraction; the gather walk (`predict_binned`) is the
+straightforward analog of the reference's pointer chase (`tree.h:112+`)
+and serves as the oracle — the two must agree to hi/lo-bf16 tolerance
+on every row, including missing-value defaults and deep skewed trees.
+"""
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.io.device import to_device
+from lightgbm_tpu.models.tree import (build_path_matrices, predict_binned,
+                                      predict_binned_matmul, stack_trees)
+
+
+@pytest.mark.parametrize("leaves,iters", [(31, 20), (255, 8)])
+def test_matmul_matches_walk(leaves, iters):
+    rng = np.random.RandomState(1)
+    n = 5000
+    X = rng.normal(size=(n, 10)).astype(np.float32)
+    X[rng.rand(n, 10) < 0.08] = np.nan          # exercise missing paths
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1]) > 0).astype(
+        np.float32)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63})
+    bst = lgb.train({"objective": "binary", "num_leaves": leaves,
+                     "num_iterations": iters, "verbose": -1,
+                     "max_bin": 63}, ds)
+    g = bst._gbdt
+    Xq = rng.normal(size=(3000, 10)).astype(np.float32)
+    Xq[rng.rand(3000, 10) < 0.08] = np.nan
+    valid = g.train_set.create_valid(Xq, prediction_mode=True)
+    dd = to_device(valid)
+
+    sub = stack_trees(g.models, max_bins=dd.max_bins + 2)
+    walk = np.asarray(predict_binned(
+        sub, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types))
+    P, plen = build_path_matrices(g.models)
+    mm = np.asarray(predict_binned_matmul(
+        sub, jnp.asarray(P), jnp.asarray(plen), dd.bins, dd.nan_bins,
+        dd.default_bins, dd.missing_types, tchunk=4, rchunk=1024))
+    # hi/lo bf16 leaf values: ~2^-15 relative per tree, summed
+    tol = 1e-3 * max(1.0, np.abs(walk).max())
+    np.testing.assert_allclose(mm, walk, atol=tol)
+
+    # ragged chunk shapes (tails in both axes) agree too
+    mm2 = np.asarray(predict_binned_matmul(
+        sub, jnp.asarray(P), jnp.asarray(plen), dd.bins, dd.nan_bins,
+        dd.default_bins, dd.missing_types, tchunk=7, rchunk=999))
+    np.testing.assert_allclose(mm2, mm, atol=1e-5)
+
+
+def test_matmul_stump_trees():
+    """Stump (single-leaf) trees and tree padding contribute exactly 0."""
+    rng = np.random.RandomState(2)
+    X = rng.normal(size=(500, 4)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 15})
+    bst = lgb.train({"objective": "binary", "num_leaves": 4,
+                     "num_iterations": 3, "verbose": -1, "max_bin": 15}, ds)
+    g = bst._gbdt
+    from lightgbm_tpu.models.tree import Tree
+    stump = Tree(2)
+    stump.leaf_value[0] = 0.0
+    models = g.models + [stump]
+    valid = g.train_set.create_valid(X, prediction_mode=True)
+    dd = to_device(valid)
+    sub = stack_trees(models, max_bins=dd.max_bins + 2)
+    P, plen = build_path_matrices(models)
+    mm = np.asarray(predict_binned_matmul(
+        sub, jnp.asarray(P), jnp.asarray(plen), dd.bins, dd.nan_bins,
+        dd.default_bins, dd.missing_types, tchunk=3, rchunk=256))
+    walk = np.asarray(predict_binned(
+        sub, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types))
+    np.testing.assert_allclose(mm, walk, atol=1e-4)
