@@ -900,8 +900,12 @@ class GBDT:
         # (minutes at 500 deep trees x 2e5 rows; long dispatches fault
         # the TPU worker).  Gated: numerical splits, bin ids <= 256
         # (bf16-exact through the MXU), unbundled columns.
+        # bin IDS consulted are <= max_bins (numeric bins <= num_bin-1;
+        # the categorical sentinel path is excluded by the num_cat gate),
+        # all bf16-exact up to 256 — the mask width (max_bins+2) is NOT
+        # the bound
         use_matmul = (not bundle_kw
-                      and dd.max_bins + 2 <= 256
+                      and dd.max_bins <= 256
                       and not any(self.models[i].num_cat > 0
                                   for i in range(T)))
         from ..models.tree import (build_path_matrices, predict_binned_matmul,

@@ -35,6 +35,10 @@
 #include <string>
 #include <vector>
 
+// public prototypes — including them makes the compiler enforce that
+// every definition below matches the ABI the header promises
+#include "lightgbm_tpu_c.h"
+
 namespace {
 
 std::mutex g_mutex;

@@ -309,7 +309,8 @@ class Config:
     # of the reference's gpu_use_dp, docs/GPU-Performance.rst:135-161):
     # "" = auto (bf16 products, f32 accumulation; see
     # learner/serial.py default_hist_mode + the recorded parity table),
-    # "bf16" | "hilo" (hi+lo bf16 pairs, ~f32 sums) | "scatter" is
+    # "bf16" | "ghilo" (hi+lo gradients, plain hess) | "hilo" (hi+lo
+    # pairs for both, ~f32 sums) | "scatter" is
     # accepted via hist_backend-style env override for debugging.
     hist_mode: str = ""
 
@@ -437,7 +438,7 @@ class Config:
             raise ValueError(f"unknown tree_learner {self.tree_learner!r}")
         if self.growth_mode not in ("wave", "leafwise"):
             raise ValueError(f"unknown growth_mode {self.growth_mode!r}")
-        if self.hist_mode not in ("", "bf16", "hilo"):
+        if self.hist_mode not in ("", "bf16", "ghilo", "hhilo", "hilo"):
             raise ValueError(f"unknown hist_mode {self.hist_mode!r}")
         # gpu_use_dp is the reference's GPU double-precision knob
         # (docs/GPU-Performance.rst): honor it as "use the high-precision

@@ -166,10 +166,35 @@ class BinaryErrorMetric(Metric):
 
 
 def binary_auc(label, score, weight=None):
-    """Tie-aware rank-sum AUC — the shared helper behind AucMetric, the
-    bench gate, and the parity tooling."""
-    return AucMetric.__new__(AucMetric).eval(
-        np.asarray(label), np.asarray(score), weight)[0][1]
+    """Tie-aware rank-sum AUC with weights (binary_metric.hpp:157-234
+    semantics, computed by sort + cumulative sums instead of bucket
+    merge) — the shared helper behind AucMetric, the bench gate, and
+    the parity tooling."""
+    label = np.asarray(label)
+    score = np.asarray(score)
+    order = np.argsort(score, kind="mergesort")
+    s = score[order]
+    y = label[order]
+    w = weight[order] if weight is not None else np.ones_like(y)
+    wp = w * (y > 0)
+    wn = w * (y <= 0)
+    # group ties: average rank treatment via per-tie-block trapezoid
+    # cumulative negatives BEFORE each block + half within block
+    boundaries = np.nonzero(np.diff(s))[0]
+    starts = np.concatenate([[0], boundaries + 1])
+    ends = np.concatenate([boundaries + 1, [len(s)]])
+    cum_neg = 0.0
+    area = 0.0
+    for a, b in zip(starts, ends):
+        bp = wp[a:b].sum()
+        bn = wn[a:b].sum()
+        area += bp * (cum_neg + 0.5 * bn)
+        cum_neg += bn
+    total_pos = wp.sum()
+    total_neg = wn.sum()
+    if total_pos == 0 or total_neg == 0:
+        return 1.0
+    return float(area / (total_pos * total_neg))
 
 
 class AucMetric(Metric):
@@ -177,31 +202,7 @@ class AucMetric(Metric):
     higher_better = True
 
     def eval(self, label, score, weight=None, query=None):
-        # rank-sum AUC with weights (binary_metric.hpp:157-234 semantics,
-        # computed by sort + cumulative sums instead of bucket merge)
-        order = np.argsort(score, kind="mergesort")
-        s = score[order]
-        y = label[order]
-        w = weight[order] if weight is not None else np.ones_like(y)
-        wp = w * (y > 0)
-        wn = w * (y <= 0)
-        # group ties: average rank treatment via per-tie-block trapezoid
-        # cumulative negatives BEFORE each block + half within block
-        boundaries = np.nonzero(np.diff(s))[0]
-        starts = np.concatenate([[0], boundaries + 1])
-        ends = np.concatenate([boundaries + 1, [len(s)]])
-        cum_neg = 0.0
-        area = 0.0
-        for a, b in zip(starts, ends):
-            bp = wp[a:b].sum()
-            bn = wn[a:b].sum()
-            area += bp * (cum_neg + 0.5 * bn)
-            cum_neg += bn
-        total_pos = wp.sum()
-        total_neg = wn.sum()
-        if total_pos == 0 or total_neg == 0:
-            return [("auc", 1.0, True)]
-        return [("auc", float(area / (total_pos * total_neg)), True)]
+        return [("auc", binary_auc(label, score, weight), True)]
 
 
 # --- multiclass (multiclass_metric.hpp:16+) --------------------------------

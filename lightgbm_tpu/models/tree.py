@@ -671,9 +671,10 @@ def predict_binned_matmul(stacked: StackedTrees,
                            c["P"].astype(jnp.bfloat16), d2,
                            preferred_element_type=jnp.float32)
             oh = (S == c["plen"].astype(jnp.float32)[:, :, None])
-            lv = c["lv"].astype(jnp.float32)
-            lv_hi = lv.astype(jnp.bfloat16)
-            lv_lo = (lv - lv_hi.astype(jnp.float32)).astype(jnp.bfloat16)
+            from ..ops.pallas_histogram import split_hi_lo
+            lv_hi_f, lv_lo_f = split_hi_lo(c["lv"].astype(jnp.float32))
+            lv_hi = lv_hi_f.astype(jnp.bfloat16)
+            lv_lo = lv_lo_f.astype(jnp.bfloat16)
             ohb = oh.astype(jnp.bfloat16)
             out = jnp.einsum("tl,tlr->r", lv_hi, ohb,
                              preferred_element_type=jnp.float32)

@@ -108,6 +108,25 @@ def _round_up(x: int, m: int) -> int:
     return -(-x // m) * m
 
 
+def split_hi_lo(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Split f32 into a bf16-representable hi + f32 residual lo.
+
+    Done by BIT-MASKING the low 16 mantissa bits, NOT by
+    ``x.astype(bf16).astype(f32)``: XLA's simplifier folds that convert
+    pair to a no-op under jit, which silently turned every hi/lo pair
+    into (x, 0) — hilo histograms degraded to plain bf16 and the
+    route-emitted leaf values lost their lo correction (found via a
+    500-iteration parity run drifting ~0.006 AUC from the exact scatter
+    path).  The masked hi is exactly bf16-representable (truncation), so
+    the MXU's operand rounding keeps it intact and ``hi + lo == x``
+    recovers f32 to ~2^-15 relative after the lo product's own rounding.
+    """
+    bits = jax.lax.bitcast_convert_type(x.astype(jnp.float32), jnp.uint32)
+    hi = jax.lax.bitcast_convert_type(
+        bits & jnp.uint32(0xFFFF0000), jnp.float32)
+    return hi, x - hi
+
+
 def bin_stride(max_bins: int) -> int:
     """Per-feature bin stride used by the kernel's joint index space."""
     return max(8, _next_pow2(max_bins))
@@ -116,7 +135,7 @@ def bin_stride(max_bins: int) -> int:
 def _col_layout(A: int, mode: str) -> tuple[int, int, int]:
     """-> (C, A_pad, cols): value columns, padded active slots, lane-
     aligned total output columns."""
-    C = 5 if mode == "hilo" else 3
+    C = {"hilo": 5, "ghilo": 4, "hhilo": 4}.get(mode, 3)
     A_pad = _round_up(A, 8)
     cols = _round_up(C * A_pad, LANE)
     return C, A_pad, cols
@@ -177,10 +196,22 @@ def pack_values(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
         return jnp.pad(x.astype(jnp.float32), pad)
 
     if mode == "hilo":
-        g_hi = grad.astype(jnp.bfloat16).astype(jnp.float32)
-        h_hi = hess.astype(jnp.bfloat16).astype(jnp.float32)
-        rows = [p(g_hi), p(grad - g_hi), p(h_hi), p(hess - h_hi),
+        g_hi, g_lo = split_hi_lo(grad)
+        h_hi, h_lo = split_hi_lo(hess)
+        rows = [p(g_hi), p(g_lo), p(h_hi), p(h_lo),
                 p(jnp.ones_like(grad))]
+    elif mode == "ghilo":
+        # hi/lo for GRADIENTS only (C=4).  Parity data: this does NOT
+        # help — grad bin sums tolerate bf16; kept for the record
+        g_hi, g_lo = split_hi_lo(grad)
+        rows = [p(g_hi), p(g_lo), p(hess), p(jnp.ones_like(grad))]
+    elif mode == "hhilo":
+        # hi/lo for HESSIANS only (C=4): the recorded parity table shows
+        # hessian precision is what drives 500-iteration quality (gains
+        # and leaf outputs divide by hessian sums), while gradient sums
+        # tolerate bf16 — 4/3 the MXU work of bf16 for hilo-grade AUC
+        h_hi, h_lo = split_hi_lo(hess)
+        rows = [p(grad), p(h_hi), p(h_lo), p(jnp.ones_like(grad))]
     else:
         rows = [p(grad), p(hess), p(jnp.ones_like(grad))]
     return jnp.stack(rows, axis=0)
@@ -271,7 +302,7 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     A = active.shape[0]
     B = bin_stride(max_bins)
 
-    _, A_pad, cols = _col_layout(A, "hilo" if C == 5 else "bf16")
+    _, A_pad, cols = _col_layout(A, mode)
     T = _pick_row_tile(n_pad, B, cols, C, row_tile)
     assert n_pad % T == 0, (n_pad, T)
     pad_cols = cols - C * A_pad
@@ -327,6 +358,12 @@ def hist_active_pallas(bins_t: jnp.ndarray,
         g = out[..., 0] + out[..., 1]
         h = out[..., 2] + out[..., 3]
         out = jnp.stack([g, h, out[..., 4]], axis=-1)
+    elif C == 4 and mode == "hhilo":
+        h = out[..., 1] + out[..., 2]
+        out = jnp.stack([out[..., 0], h, out[..., 3]], axis=-1)
+    elif C == 4:
+        g = out[..., 0] + out[..., 1]
+        out = jnp.stack([g, out[..., 2], out[..., 3]], axis=-1)
     return out
 
 
@@ -506,7 +543,7 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
     A = active.shape[0]
     B = bin_stride(max_bins)
 
-    _, A_pad, cols = _col_layout(A, "hilo" if C == 5 else "bf16")
+    _, A_pad, cols = _col_layout(A, mode)
     # the fused kernel holds ALL stored columns in one tile: halve the
     # row tile until that cell fits the VMEM budget
     T = row_tile
@@ -569,4 +606,10 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
         g = out[..., 0] + out[..., 1]
         h = out[..., 2] + out[..., 3]
         out = jnp.stack([g, h, out[..., 4]], axis=-1)
+    elif C == 4 and mode == "hhilo":
+        h = out[..., 1] + out[..., 2]
+        out = jnp.stack([out[..., 0], h, out[..., 3]], axis=-1)
+    elif C == 4:
+        g = out[..., 0] + out[..., 1]
+        out = jnp.stack([g, out[..., 2], out[..., 3]], axis=-1)
     return out, leaf2_new

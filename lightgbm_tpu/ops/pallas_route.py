@@ -192,10 +192,10 @@ def _leaf_tables(feature, threshold, default_left, is_categorical, sel,
     tabs = tabs.at[_T_MT, :L].set(missing_types[f].astype(jnp.float32))
     tabs = tabs.at[_T_NANB, :L].set(nan_bins[f].astype(jnp.float32))
     if leaf_values is not None:
-        lv = leaf_values.astype(jnp.float32)
-        hi = lv.astype(jnp.bfloat16).astype(jnp.float32)
+        from .pallas_histogram import split_hi_lo
+        hi, lo = split_hi_lo(leaf_values.astype(jnp.float32))
         tabs = tabs.at[_T_LVH, :L].set(hi)
-        tabs = tabs.at[_T_LVL, :L].set(lv - hi)
+        tabs = tabs.at[_T_LVL, :L].set(lo)
     return tabs
 
 

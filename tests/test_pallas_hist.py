@@ -53,6 +53,54 @@ def test_kernel_matches_scatter(max_bins, F, mode):
                                atol=tol)
 
 
+def test_hilo_split_survives_jit():
+    """Regression: the hi/lo split must be done by bit-masking — XLA's
+    simplifier folds ``x.astype(bf16).astype(f32)`` to a no-op under
+    jit, which silently collapsed hilo mode to plain bf16 AND rounded
+    the route-emitted leaf values (≈0.006 AUC drift at 500 iterations
+    against the exact scatter path before the fix)."""
+    from lightgbm_tpu.ops.pallas_histogram import split_hi_lo
+    rng = np.random.RandomState(0)
+    g = jnp.asarray(rng.normal(size=4096).astype(np.float32))
+    v = np.asarray(jax.jit(lambda a: pack_values(a, a, "hilo"))(g))
+    lo = v[1][:4096]
+    assert (lo != 0).mean() > 0.99          # folded split would be all-0
+    hi = v[0][:4096]
+    # hi exactly bf16-representable: MXU operand rounding keeps it intact
+    np.testing.assert_array_equal(
+        hi, hi.astype(jnp.bfloat16).__array__().astype(np.float32))
+    np.testing.assert_array_equal(hi + lo, np.asarray(g))
+    # the jitted helper itself
+    h2, l2 = jax.jit(split_hi_lo)(g)
+    np.testing.assert_array_equal(np.asarray(h2) + np.asarray(l2),
+                                  np.asarray(g))
+    assert (np.asarray(l2) != 0).mean() > 0.99
+
+
+def test_hilo_hist_accuracy_vs_exact():
+    """hilo histograms must be ~f32-accurate (not bf16-grade): compare
+    against an exact float64 host histogram at a size where the two
+    regimes differ by two orders of magnitude."""
+    rng = np.random.RandomState(1)
+    n, F, B = 20000, 4, 64
+    bins = rng.randint(0, 63, size=(n, F)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 0.3, size=n).astype(np.float32)
+    exact = np.zeros((F, B))
+    for f in range(F):
+        exact[f] = np.bincount(bins[:, f], weights=grad.astype(np.float64),
+                               minlength=B)[:B]
+    leaf = jnp.zeros(n, jnp.int32)
+    active = jnp.full(8, -1, jnp.int32).at[0].set(0)
+    vals = pack_values(jnp.asarray(grad), jnp.asarray(hess), "hilo")
+    hp = np.asarray(hist_active_pallas(
+        transpose_bins(jnp.asarray(bins)), vals, leaf, active,
+        num_features=F, max_bins=63, mode="hilo",
+        interpret=True))[0][..., 0]
+    rel = np.abs(hp - exact).max() / np.abs(exact).max()
+    assert rel < 5e-5, rel                  # bf16-grade would be ~1e-3
+
+
 def test_scatter_drops_inactive_and_padding():
     rng = np.random.RandomState(3)
     n, F, L = 500, 4, 7

@@ -46,13 +46,8 @@ def make_data(seed, n):
 
 
 def auc(label, score):
-    order = np.argsort(score, kind="stable")
-    ranks = np.empty(len(score))
-    ranks[order] = np.arange(1, len(score) + 1)
-    npos = label.sum()
-    nneg = len(label) - npos
-    return float((ranks[label > 0.5].sum() - npos * (npos + 1) / 2)
-                 / (npos * nneg))
+    from lightgbm_tpu.metric.metrics import binary_auc
+    return binary_auc(label, score)
 
 
 def run_child(mode, n_train):
@@ -99,10 +94,18 @@ def main():
         mode, n_train = sys.argv[1], int(sys.argv[2])
         print("PARITY_RESULT " + json.dumps(run_child(mode, n_train)))
         return
-    legs = [("bf16", N_FULL), ("hilo", N_FULL),
-            ("bf16", N_SMALL), ("hilo", N_SMALL), ("scatter", N_SMALL)]
+    legs = [("bf16", N_FULL), ("hilo", N_FULL), ("ghilo", N_FULL),
+            ("hhilo", N_FULL),
+            ("bf16", N_SMALL), ("hilo", N_SMALL), ("ghilo", N_SMALL),
+            ("hhilo", N_SMALL), ("scatter", N_SMALL)]
     results = []
+    if os.path.exists(ARTIFACT):
+        with open(ARTIFACT) as f:
+            results = json.load(f)["results"]
+    done = {(r["mode"], r["n_train"]) for r in results}
     for mode, n_train in legs:
+        if (mode, n_train) in done:
+            continue
         env = dict(os.environ)
         env["LGBM_TPU_HIST_MODE"] = mode if mode != "scatter" else "bf16"
         if mode == "scatter":
