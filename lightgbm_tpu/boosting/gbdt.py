@@ -574,14 +574,19 @@ class GBDT:
         self._stacked_cache = None
 
     def merge_from(self, other: "GBDT") -> None:
-        """Append the other booster's trees (reference GBDT::MergeFrom,
-        gbdt.h:50-67).  Scores are refreshed from the merged trees when a
-        train set is attached."""
+        """Merge the other booster's trees in FRONT of this booster's, as
+        deep copies (reference GBDT::MergeFrom, gbdt.h:50-67: other's
+        trees are pushed first, then the original models, every tree
+        copy-constructed — so iteration-limited predict/save and
+        tree-indexed leaf access order like the reference, and mutating
+        either booster afterwards never aliases the other).  Scores are
+        refreshed from the merged trees when a train set is attached."""
+        import copy
         if other.num_tree_per_iteration != self.num_tree_per_iteration:
             raise ValueError("cannot merge boosters with different "
                              "num_tree_per_iteration")
-        new = list(other.models)
-        self.models = self.models + new
+        new = [copy.deepcopy(t) for t in other.models]
+        self.models = new + list(self.models)
         K = max(1, self.num_tree_per_iteration)
         self.iter = len(self._host_models) // K
         if self.train_set is not None:

@@ -272,6 +272,17 @@ void append_utf8(std::string* s, unsigned cp) {
 // GetFeatureNames / GetEvalNames calls (reference copies into
 // caller-provided char** out_strs, c_api.h:243-251,450-456).  Full JSON
 // string unescaping incl. \uXXXX (json.dumps emits ensure_ascii output).
+//
+// The v2.1.0 API carries no per-string buffer length, so callers must
+// provide at least LGBM_TPU_MAX_NAME_LEN bytes per name (the caller
+// contract, declared in the public header; the later reference API grew
+// buffer_len parameters for exactly this hazard); names longer than that
+// are truncated with explicit NUL-termination instead of overflowing the
+// caller's buffers.  Truncation never splits a multi-byte UTF-8 sequence
+// (copy_names itself decodes \uXXXX escapes into UTF-8, and e.g. JNI's
+// strict UTF-8 conversion rejects malformed strings).
+static const size_t kMaxNameLen = LGBM_TPU_MAX_NAME_LEN;
+
 int copy_names(const char* json_names, int* out_len, char** out_strs) {
   std::vector<std::string> names;
   const char* p = json_names;
@@ -318,7 +329,15 @@ int copy_names(const char* json_names, int* out_len, char** out_strs) {
   *out_len = (int)names.size();
   if (out_strs != nullptr) {
     for (size_t i = 0; i < names.size(); ++i) {
-      std::strcpy(out_strs[i], names[i].c_str());
+      size_t n = names[i].size();
+      if (n >= kMaxNameLen) {
+        n = kMaxNameLen - 1;
+        // back off any UTF-8 continuation bytes so the cut lands on a
+        // codepoint boundary
+        while (n > 0 && (names[i][n] & 0xC0) == 0x80) --n;
+      }
+      std::memcpy(out_strs[i], names[i].data(), n);
+      out_strs[i][n] = '\0';  // writes exactly n+1 bytes, never past cap
     }
   }
   return 0;
@@ -596,9 +615,10 @@ int LGBM_BoosterMerge(BoosterHandle handle,
 
 int LGBM_BoosterAddValidData(BoosterHandle handle,
                              const DatasetHandle valid_data) {
+  // empty name -> bridge generates the reference's "valid_N" convention
   return call_int("booster_add_valid", nullptr, "(LLs)",
                   (long long)(intptr_t)handle,
-                  (long long)(intptr_t)valid_data, "valid");
+                  (long long)(intptr_t)valid_data, "");
 }
 
 int LGBM_BoosterResetTrainingData(BoosterHandle handle,

@@ -79,6 +79,12 @@ int LGBM_DatasetGetSubset(const DatasetHandle handle,
                           const char* parameters, DatasetHandle* out);
 int LGBM_DatasetSetFeatureNames(DatasetHandle handle,
                                 const char** feature_names, int num);
+/* The name-returning calls (DatasetGetFeatureNames, BoosterGetEvalNames,
+ * BoosterGetFeatureNames) copy into caller-provided char** buffers with
+ * no per-string length parameter (v2.1.0 API shape).  Each buffer must
+ * be at least LGBM_TPU_MAX_NAME_LEN bytes; longer names are truncated at
+ * a UTF-8 codepoint boundary and NUL-terminated. */
+#define LGBM_TPU_MAX_NAME_LEN 256
 int LGBM_DatasetGetFeatureNames(DatasetHandle handle, char** feature_names,
                                 int* num_feature_names);
 int LGBM_DatasetFree(DatasetHandle handle);

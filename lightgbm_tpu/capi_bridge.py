@@ -183,15 +183,28 @@ class _StreamingDataset:
         self.params = params
         self.reference = reference
         self.pushed = 0
+        self._covered = np.zeros(nrow, bool)  # which row indices arrived
         self.dataset = None                  # becomes lgb.Dataset
 
     def push(self, rows: np.ndarray, start_row: int):
         if self.dataset is not None:
             raise RuntimeError(
                 "dataset already finalized: all rows were pushed")
-        self.X[start_row:start_row + rows.shape[0]] = rows
+        end_row = start_row + rows.shape[0]
+        if end_row > self.X.shape[0]:
+            raise ValueError(
+                f"push of rows [{start_row}, {end_row}) exceeds declared "
+                f"nrow {self.X.shape[0]}")
+        if self._covered[start_row:end_row].any():
+            raise ValueError(
+                f"rows in [{start_row}, {end_row}) were already pushed")
+        self.X[start_row:end_row] = rows
+        self._covered[start_row:end_row] = True
         self.pushed += rows.shape[0]
-        if self.pushed >= self.X.shape[0]:
+        # finalize only once EVERY row index has been written — a pure
+        # count would finalize early (zero-filling gaps) on overlapping
+        # or out-of-order pushes
+        if self._covered.all():
             self._finish()
 
     def _finish(self):
@@ -320,8 +333,9 @@ def booster_load_model_from_string(model_str: str) -> int:
 
 
 def booster_merge(h: int, other_h: int) -> None:
-    """LGBM_BoosterMerge (c_api.h:364-371): append the other booster's
-    trees (reference GBDT::MergeFrom, gbdt.h:50-67)."""
+    """LGBM_BoosterMerge (c_api.h:364-371): merge the other booster's
+    trees in FRONT of this booster's, as copies (reference
+    GBDT::MergeFrom, gbdt.h:50-67)."""
     _get(h)._gbdt.merge_from(_get(other_h)._gbdt)
 
 
@@ -330,10 +344,16 @@ def booster_add_valid(h: int, valid_handle: int, name: str) -> None:
     if isinstance(valid, _StreamingDataset):
         valid = valid._require()
     b = _get(h)
-    # unique per-index names (the reference's "valid_1"/"valid_2"
-    # convention): GetEval selects by data_idx, which needs the sets
-    # distinguishable
-    b.add_valid(valid, f"valid_{len(b._name_valid_sets) + 1}")
+    # caller-supplied name when given, else the reference's
+    # "valid_1"/"valid_2" convention: GetEval selects by data_idx, which
+    # needs the sets distinguishable
+    base = name.strip() if name else ""
+    if not base or base in b._name_valid_sets:
+        i = len(b._name_valid_sets) + 1
+        while f"valid_{i}" in b._name_valid_sets:
+            i += 1
+        base = f"valid_{i}"
+    b.add_valid(valid, base)
 
 
 def booster_reset_training_data(h: int, train_handle: int) -> None:

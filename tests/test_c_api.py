@@ -236,7 +236,7 @@ int main(int argc, char** argv) {
   /* feature names round-trip */
   const char* names_in[4] = {"a", "b", "c", "d"};
   CHECK(LGBM_DatasetSetFeatureNames(ds, names_in, f));
-  char name_bufs[4][64];
+  char name_bufs[4][256];  /* LGBM_TPU_MAX_NAME_LEN */
   char* names_out[4] = {name_bufs[0], name_bufs[1], name_bufs[2],
                         name_bufs[3]};
   int n_names = 0;
@@ -275,7 +275,7 @@ int main(int argc, char** argv) {
 
   int eval_counts = 0;
   CHECK(LGBM_BoosterGetEvalCounts(bst, &eval_counts));
-  char ename_bufs[8][64];
+  char ename_bufs[8][256];  /* LGBM_TPU_MAX_NAME_LEN */
   char* enames[8];
   for (int i = 0; i < 8; ++i) enames[i] = ename_bufs[i];
   int n_enames = 0;

@@ -190,6 +190,32 @@ def test_continued_training():
     np.testing.assert_allclose(p1, p2, atol=1e-5)
 
 
+def test_merge_from_prepends_deep_copies():
+    """Reference GBDT::MergeFrom (gbdt.h:50-67): other's trees are
+    inserted in FRONT as copies, and no Tree object is shared between
+    the two boosters afterwards."""
+    X, y = _binary_data()
+    bst_a = lgb.train({"objective": "binary"}, lgb.Dataset(X, label=y), 3,
+                      verbose_eval=False)
+    bst_b = lgb.train({"objective": "binary", "num_leaves": 7},
+                      lgb.Dataset(X, label=y), 2, verbose_eval=False)
+    ga, gb = bst_a._gbdt, bst_b._gbdt
+    a_trees, b_trees = list(ga.models), list(gb.models)
+    ga.merge_from(gb)
+    merged = ga.models
+    assert len(merged) == 5
+    # other's trees come first, in order, as deep copies (self's own trees
+    # follow; they need no copy — the fresh list already isolates them)
+    for i, src in enumerate(b_trees + a_trees):
+        np.testing.assert_array_equal(merged[i].leaf_value, src.leaf_value)
+    for i, src in enumerate(b_trees):
+        assert merged[i] is not src
+    # mutating the merged booster's copy must not touch the source tree
+    before = b_trees[0].leaf_value.copy()
+    merged[0].leaf_value[0] += 123.0
+    np.testing.assert_array_equal(b_trees[0].leaf_value, before)
+
+
 def test_save_load_pickle(tmp_path):
     X, y = _binary_data()
     train = lgb.Dataset(X, label=y)
