@@ -6,6 +6,10 @@ with leaf-wise growth, DART/GOSS/RF boosting, 16 objectives, 21 metrics,
 categorical features, EFB, distributed data/feature/voting-parallel
 learners over jax.sharding meshes, and a scikit-learn compatible API.
 """
+from .utils.compile_cache import enable_default_compile_cache
+
+enable_default_compile_cache()
+
 from .basic import Booster, Dataset
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter)

@@ -40,6 +40,26 @@ from ..utils.log import log_info, log_warning
 K_MODEL_VERSION = "v2"     # reference gbdt_model_text.cpp:13
 
 
+def _device_bag_mask(seed: int, epoch, n: int, fraction: float):
+    """Bernoulli row mask, pure in (seed, bagging epoch).  Traceable:
+    ``epoch`` may be a scan carry, so the fused block derives per-epoch
+    masks on device with no host RNG in the loop (reference Bagging,
+    gbdt.cpp:225-286, re-bags every bagging_freq iterations)."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), epoch)
+    return jax.random.uniform(key, (n,)) < fraction
+
+
+def _device_feature_mask(seed: int, tree_idx, F: int, k: int):
+    """Exactly-k feature mask, pure in (seed, global tree index)
+    (serial_tree_learner.cpp:240-266 samples k features per tree).
+    Top-k over uniforms instead of choice-without-replacement: one sort,
+    no sequential draws — and traceable inside ``lax.scan``."""
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), tree_idx)
+    r = jax.random.uniform(key, (F,))
+    kth = jax.lax.top_k(r, k)[0][-1]
+    return r >= kth
+
+
 def split_params_from_config(c: Config) -> SplitParams:
     return SplitParams(
         lambda_l1=c.lambda_l1, lambda_l2=c.lambda_l2,
@@ -161,22 +181,23 @@ class GBDT:
             self.num_tree_per_iteration = self.objective.num_model_per_iteration
 
         K = self.num_tree_per_iteration
-        self.scores = jnp.zeros((n, K), jnp.float32)
+        # scores built host-side and device_put in one transfer: eager
+        # jnp.zeros/full each compile a mini-program over the tunnel
+        scores_np = np.zeros((n, K), np.float32)
         # init score from metadata (continued training / custom init)
         ms = train_set.metadata.init_score
         if ms is not None:
-            init = np.asarray(ms, np.float64).reshape(-1, K, order="F")
-            self.scores = jnp.asarray(init, jnp.float32)
+            scores_np = np.asarray(ms, np.float64).reshape(
+                -1, K, order="F").astype(np.float32)
         elif c.boost_from_average and self.objective is not None:
             v = self.objective.boost_from_score()
             if v != 0.0:
                 self.init_score_value = v
-                self.scores = jnp.full((n, K), v, jnp.float32)
+                scores_np = np.full((n, K), v, np.float32)
                 log_info(f"boost from average: init score = {v:.6f}")
+        self.scores = jax.device_put(scores_np)
 
         self.growth = growth_params_from_config(c)
-        self._rng_bag = np.random.RandomState(c.bagging_seed)
-        self._rng_feat = np.random.RandomState(c.feature_fraction_seed)
         self._label = train_set.metadata.label
         self._weight = train_set.metadata.weight
         self._query = train_set.metadata.query_boundaries
@@ -201,7 +222,19 @@ class GBDT:
             self._bins_t = None
             if resolve_backend(self.device_data, growth.num_leaves,
                                hist_mode=hist_mode) == "pallas":
-                self._bins_t = jax.jit(transpose_bins)(self.device_data.bins)
+                bins_host = (self.train_set.bins
+                             if self.train_set is not None else None)
+                if (bins_host is not None
+                        and bins_host.shape[0] <= 1 << 20):
+                    # small data: transpose on host — the jitted
+                    # transpose's one-time compile over the tunnel
+                    # dwarfs the duplicate copy
+                    from ..ops.pallas_histogram import transpose_bins_host
+                    self._bins_t = jax.device_put(
+                        transpose_bins_host(bins_host))
+                else:
+                    self._bins_t = jax.jit(transpose_bins)(
+                        self.device_data.bins)
             from ..utils.timetag import phases_enabled
             if phases_enabled():
                 # LGBM_TPU_TIMETAG=phases: unfused per-phase-timed waves
@@ -243,6 +276,7 @@ class GBDT:
         self._jit_build = (_raw_build if self.mesh_ctx is None
                            else jax.jit(_raw_build))
         self._block_fns: Dict[int, object] = {}
+        self._block_len_uses: Dict[int, int] = {}
         # how often the host checks trees for the no-more-splits stop
         # (reference checks every iteration, gbdt.cpp:435-470; through a
         # remote tunnel each check is a ~100ms round-trip)
@@ -290,25 +324,28 @@ class GBDT:
     # ------------------------------------------------------------------
     def _bagging_mask(self, it: int) -> Optional[jnp.ndarray]:
         """Row subsampling mask (reference Bagging, gbdt.cpp:225-286 —
-        PRNG masks instead of index compaction: TPU-idiomatic)."""
+        PRNG masks instead of index compaction: TPU-idiomatic).
+
+        Stateless in (seed, iteration): the mask is a pure function of
+        ``bagging_seed`` and ``it // bagging_freq``, so the fused block
+        path derives the *identical* mask on device inside its
+        ``lax.scan`` and block/non-block training produce the same
+        models."""
         c = self.config
         if c.bagging_freq <= 0 or c.bagging_fraction >= 1.0:
             return None
-        if it % c.bagging_freq == 0:
-            self._cur_bag = self._rng_bag.rand(self.num_data) < c.bagging_fraction
-        return jnp.asarray(self._cur_bag)
+        return _device_bag_mask(c.bagging_seed, it // c.bagging_freq,
+                                self.num_data, c.bagging_fraction)
 
-    def _feature_mask(self) -> Optional[jnp.ndarray]:
-        """Per-tree feature subsampling (serial_tree_learner.cpp:240-266)."""
+    def _feature_mask(self, tree_idx: int) -> Optional[jnp.ndarray]:
+        """Per-tree feature subsampling (serial_tree_learner.cpp:240-266),
+        stateless in (seed, global tree index) — see _bagging_mask."""
         c = self.config
         F = self.device_data.num_features
         if c.feature_fraction >= 1.0:
             return None
         k = max(1, int(c.feature_fraction * F))
-        sel = self._rng_feat.choice(F, k, replace=False)
-        mask = np.zeros(F, bool)
-        mask[sel] = True
-        return jnp.asarray(mask)
+        return _device_feature_mask(c.feature_fraction_seed, tree_idx, F, k)
 
     def _gradients(self) -> Tuple[jnp.ndarray, jnp.ndarray]:
         """(grad, hess) each [n, K] (reference Boosting(), gbdt.cpp:194-202)."""
@@ -355,21 +392,24 @@ class GBDT:
             fetched = jax.device_get([p[0] for p in self._pending])
             K = max(1, self.num_tree_per_iteration)
             for f, (_, lr, bias, count) in zip(fetched, self._pending):
-                # blocks carry a leading scan axis even at length 1
+                # blocks carry a leading scan axis even at length 1; the
+                # fixed-length block may hold masked residue iterations
+                # past `count` trees — never materialized
                 if np.ndim(f.num_leaves) == 0:
                     parts = [f]
                 elif K == 1:
-                    NB = f.num_leaves.shape[0]
+                    NB = min(f.num_leaves.shape[0], count)
                     parts = [jax.tree.map(lambda a, i=i: a[i], f)
                              for i in range(NB)]
                 else:
-                    NB = f.num_leaves.shape[0]
+                    NB = min(f.num_leaves.shape[0], count // K)
                     parts = [jax.tree.map(lambda a, i=i, k=k: a[i, k], f)
                              for i in range(NB) for k in range(K)]
-                for bt_np in parts:
+                for pi, bt_np in enumerate(parts):
                     host = self._to_host_tree(bt_np)
                     host.shrinkage(lr)
-                    if bias:
+                    if bias and pi < K:
+                        # init score lives in the first tree per class
                         host.add_bias(bias)
                     self._host_models.append(host)
             self._pending = []
@@ -395,7 +435,7 @@ class GBDT:
         K = self.num_tree_per_iteration
         iter_trees = []
         for k in range(K):
-            fmask = self._feature_mask()
+            fmask = self._feature_mask(self.iter * K + k)
             with tag("tree") as done:
                 bt = self._build_tree(grad[:, k], hess[:, k], bag, fmask)
                 done(bt.num_leaves)
@@ -673,10 +713,13 @@ class GBDT:
         collapses a whole window of iterations into a single dispatch
         (gradients → tree build → score update chained on device).
         Excluded: distributed meshes (own path), custom fobj (host
-        callback), leaf renewal (quantile-style refit), bagging/feature
-        sampling (host RNG parity), valid sets (per-tree score replay),
-        non-plain boosters (DART/GOSS/RF override the iteration), and
-        the per-phase timetag debug mode (host-driven waves)."""
+        callback), leaf renewal (quantile-style refit), valid sets
+        (per-tree score replay), non-plain boosters (DART/GOSS/RF
+        override the iteration), and the per-phase timetag debug mode
+        (host-driven waves).  Bagging and feature_fraction stay IN the
+        block: their masks are pure functions of (seed, iteration) /
+        (seed, tree index), derived on device inside the scan body —
+        identical to the per-iteration path's masks."""
         from ..utils.timetag import phases_enabled
         if phases_enabled():
             return False
@@ -685,18 +728,22 @@ class GBDT:
             # large n) can push a 32-iteration block past the device's
             # dispatch watchdog; per-iteration dispatches stay short
             return False
-        c = self.config
         return (self.boosting_name == "gbdt"
                 and self.mesh_ctx is None
                 and self.fobj is None
                 and self.objective is not None
                 and not self.objective.need_renew_tree_output
-                and not self._valid_device
-                and (c.bagging_freq <= 0 or c.bagging_fraction >= 1.0)
-                and c.feature_fraction >= 1.0)
+                and not self._valid_device)
 
-    def _block_fn(self, nb: int):
-        fn = self._block_fns.get(nb)
+    def _block_fn(self, cap: int):
+        """A jitted fixed-length-``cap`` scan block.  Iterations past
+        ``n_active`` run masked: their score update is discarded and
+        their trees are never materialized host-side.  Masking decouples
+        requested block length from compiled scan length — compile
+        count, not FLOPs, is the real cold-start cost on a remote TPU
+        (~12-30 s per program vs ~10 ms per masked iteration).  See
+        train_block for the reuse policy."""
+        fn = self._block_fns.get(cap)
         if fn is not None:
             return fn
         obj = self.objective
@@ -704,17 +751,36 @@ class GBDT:
         dd = self.device_data
         bins_t = self._bins_t
         K = self.num_tree_per_iteration
+        c = self.config
+        n = self.num_data
+        F = dd.num_features
+        bag_on = c.bagging_freq > 0 and c.bagging_fraction < 1.0
+        ff_on = c.feature_fraction < 1.0
+        kf = max(1, int(c.feature_fraction * F))
 
-        def block(scores, lr):
-            def body(scores, _):
+        def block(scores, lr, it0, n_active):
+            def body(scores, it):
+                active = it - it0 < n_active
+                scores_in = scores
                 if K == 1:
                     g, h = obj.get_gradients(scores[:, 0])
                     G, H = g[:, None], h[:, None]
                 else:
                     G, H = obj.get_gradients(scores)
+                # sampling masks derived on device, pure in iteration —
+                # the same functions the per-iteration path calls, so a
+                # bagged config no longer falls off the fused fast path
+                bag = (_device_bag_mask(c.bagging_seed,
+                                        it // c.bagging_freq, n,
+                                        c.bagging_fraction)
+                       if bag_on else None)
                 outs = []
                 for k in range(K):
+                    fmask = (_device_feature_mask(c.feature_fraction_seed,
+                                                  it * K + k, F, kf)
+                             if ff_on else None)
                     bt = build_tree(dd, G[:, k], H[:, k], growth,
+                                    bag_mask=bag, feature_mask=fmask,
                                     bins_t=bins_t)
                     lv = jnp.where(bt.num_leaves > 1, bt.leaf_value,
                                    jnp.zeros_like(bt.leaf_value))
@@ -729,14 +795,40 @@ class GBDT:
                                             row_value=bt.row_value[:0]))
                 stacked = (outs[0] if K == 1 else
                            jax.tree.map(lambda *xs: jnp.stack(xs), *outs))
-                return scores, stacked
-            return jax.lax.scan(body, scores, None, length=nb)
+                # masked residue iteration: keep the pre-iteration scores
+                # (its trees are dropped host-side via the pending count)
+                return jnp.where(active, scores, scores_in), stacked
+            return jax.lax.scan(body, scores, it0 + jnp.arange(cap))
 
         fn = jax.jit(block)
-        self._block_fns[nb] = fn
+        self._block_fns[cap] = fn
         return fn
 
     _BLOCK_CAP = 32
+
+    def _pick_block_len(self, nb: int) -> int:
+        """Compiled scan length for a block of ``nb`` active iterations.
+
+        Right size is the next power of two (masked waste < 2x), but a
+        fresh length costs a full XLA compile, so: reuse an exact-length
+        program when one exists; otherwise borrow the smallest
+        already-compiled length >= nb on this length's FIRST request
+        (a one-off residue — e.g. 100 = 3x32 + 4 — should never compile
+        a second program just to skip 28 masked iterations); compile the
+        right size once the same length recurs (windowed runs —
+        output_freq / snapshot_freq — would otherwise pay the masked
+        waste on EVERY window, review finding r4)."""
+        L = 1
+        while L < nb:
+            L *= 2
+        uses = self._block_len_uses.get(L, 0) + 1
+        self._block_len_uses[L] = uses
+        if L in self._block_fns:
+            return L
+        borrow = [l for l in self._block_fns if l >= nb]
+        if borrow and uses < 2:
+            return min(borrow)
+        return L
 
     def train_block(self, num_iters: int) -> bool:
         """Run up to ``num_iters`` iterations, batching into scan blocks
@@ -745,32 +837,33 @@ class GBDT:
         from ..utils.timetag import tag
         done = 0
         while done < num_iters:
-            if not self._can_block() or (
-                    self._num_models() == 0
-                    and abs(self.init_score_value) > 1e-15):
-                # bias baking / unsupported config: per-iteration path
+            if not self._can_block():
+                # unsupported config: per-iteration path
                 if self.train_one_iter():
                     return True
                 done += 1
                 continue
-            # power-of-two block lengths: any residue reuses one of at
-            # most log2(cap) compiled programs instead of compiling a
-            # fresh scan length mid-run
             nb = min(num_iters - done, self._BLOCK_CAP)
-            while nb & (nb - 1):
-                nb &= nb - 1
-            fn = self._block_fn(nb)
+            fn = self._block_fn(self._pick_block_len(nb))
             with tag("block") as tdone:
                 self.scores, trees = fn(self.scores,
-                                        jnp.float32(self.shrinkage_rate))
+                                        jnp.float32(self.shrinkage_rate),
+                                        jnp.int32(self.iter),
+                                        jnp.int32(nb))
                 tdone(trees.num_leaves)
             K = self.num_tree_per_iteration
-            self._pending.append((trees, self.shrinkage_rate, 0.0, nb * K))
+            # init-score bias rides the pending entry and is baked into
+            # the first K host trees at flush (no separate per-iteration
+            # bias-bake dispatch, which cost a whole extra XLA program)
+            bias = (self.init_score_value
+                    if (self._num_models() == 0
+                        and abs(self.init_score_value) > 1e-15) else 0.0)
+            self._pending.append((trees, self.shrinkage_rate, bias, nb * K))
             self.iter += nb
             self._stacked_cache = None
             done += nb
             # stump stop: ONE tiny fetch per block (vs per iteration)
-            last_nl = np.atleast_1d(jax.device_get(trees.num_leaves[-1]))
+            last_nl = np.atleast_1d(jax.device_get(trees.num_leaves[nb - 1]))
             if all(int(x) <= 1 for x in last_nl):
                 self.trim_trailing_stumps()
                 log_warning(

@@ -150,7 +150,7 @@ class GOSS(GBDT):
         finished = True
         K = self.num_tree_per_iteration
         for k in range(K):
-            fmask = self._feature_mask()
+            fmask = self._feature_mask(self.iter * K + k)
             bt = self._build_tree(grad[:, k], hess[:, k], bag, fmask)
             if int(bt.num_leaves) > 1:
                 finished = False

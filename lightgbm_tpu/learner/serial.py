@@ -115,6 +115,13 @@ def _round8(x: int) -> int:
     return -(-x // 8) * 8
 
 
+# datasets at or below this row count take the single-body compile-lean
+# path (override for A/B: LGBM_TPU_COMPILE_LEAN_ROWS)
+import os as _os_env
+_COMPILE_LEAN_ROWS = int(_os_env.environ.get("LGBM_TPU_COMPILE_LEAN_ROWS",
+                                             65536))
+
+
 def stage_plan(L: int, wave_size: int = 0):
     """Active-slot counts for the unrolled waves + the while-loop tail.
 
@@ -384,6 +391,14 @@ def build_tree(data: DeviceData,
     # stages × shard_map × 3 learners is minutes of XLA-CPU compile time)
     if backend == "pallas":
         plan, A_tail = stage_plan(L, params.wave_size)
+        # compile-lean: on small datasets the staged unrolled waves buy
+        # nothing (MXU cost ∝ slots×n is trivial) but multiply HLO size
+        # ~7x — and XLA compile time, not FLOPs, dominates small-data
+        # cold starts (~30 s vs ~1.5 s of device work for 100
+        # iterations).  One full-width while-loop body compiles once and
+        # runs the same wave sequence.
+        if n <= _COMPILE_LEAN_ROWS and params.wave_size != 1:
+            plan = []
     else:
         plan, A_tail = [], _round8(max(1, L // 2))
     wave_cap = params.wave_size if params.wave_size > 0 else L

@@ -51,6 +51,15 @@ class ObjectiveFunction:
 
     def init(self, metadata, num_data: int) -> None:
         self.num_data = num_data
+        # host copies kept alongside the device arrays: BoostFromScore
+        # runs once at booster init, where every eager device op over a
+        # remote-TPU tunnel costs a ~1s mini-compile (label/weight arrive
+        # host-side anyway, so this is free)
+        self._label_np = (np.asarray(metadata.label, np.float32)
+                          if metadata.label is not None
+                          else np.zeros(num_data, np.float32))
+        self._weight_np = (np.asarray(metadata.weight, np.float32)
+                           if metadata.weight is not None else None)
         self.label = (jnp.asarray(metadata.label, jnp.float32)
                       if metadata.label is not None else jnp.zeros(num_data))
         self.weight = (jnp.asarray(metadata.weight, jnp.float32)
@@ -58,6 +67,14 @@ class ObjectiveFunction:
         if metadata.query_boundaries is not None:
             self.query_boundaries = np.asarray(metadata.query_boundaries)
         self._check_label()
+
+    def _host_label_mean(self) -> float:
+        """Weighted label mean, on host (see init)."""
+        y = self._label_np
+        if self._weight_np is not None:
+            w = self._weight_np
+            return float((y * w).sum() / w.sum())
+        return float(y.mean())
 
     def _check_label(self) -> None:
         pass
@@ -94,7 +111,9 @@ class RegressionL2(ObjectiveFunction):
         super().init(metadata, num_data)
         if self.sqrt:
             self.raw_label = self.label
-            self.label = jnp.sign(self.raw_label) * jnp.sqrt(jnp.abs(self.raw_label))
+            self._label_np = (np.sign(self._label_np)
+                              * np.sqrt(np.abs(self._label_np)))
+            self.label = jnp.asarray(self._label_np)
 
     def get_gradients(self, score):
         grad = score - self.label
@@ -103,9 +122,7 @@ class RegressionL2(ObjectiveFunction):
 
     def boost_from_score(self):
         # weighted mean label (regression_objective.hpp BoostFromScore)
-        if self.weight is not None:
-            return float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
-        return float(jnp.mean(self.label))
+        return self._host_label_mean()
 
     def convert_output(self, score):
         if self.sqrt:
@@ -170,7 +187,7 @@ class Poisson(ObjectiveFunction):
         self.max_delta_step = float(config.poisson_max_delta_step)
 
     def _check_label(self):
-        if bool(jnp.any(self.label < 0)):
+        if (self._label_np < 0).any():
             raise ValueError("poisson objective requires non-negative labels")
 
     def get_gradients(self, score):
@@ -180,11 +197,7 @@ class Poisson(ObjectiveFunction):
         return _apply_weight(grad, hess, self.weight)
 
     def boost_from_score(self):
-        if self.weight is not None:
-            mean = jnp.sum(self.label * self.weight) / jnp.sum(self.weight)
-        else:
-            mean = jnp.mean(self.label)
-        return float(jnp.log(jnp.maximum(mean, 1e-20)))
+        return float(np.log(max(self._host_label_mean(), 1e-20)))
 
     def convert_output(self, score):
         return jnp.exp(score)
@@ -272,13 +285,13 @@ class BinaryLogloss(ObjectiveFunction):
         self.label_weights = (1.0, 1.0)
 
     def _check_label(self):
-        u = np.unique(np.asarray(self.label))
+        u = np.unique(self._label_np)
         if not np.all(np.isin(u, [0.0, 1.0])):
             raise ValueError("binary objective requires labels in {0, 1}")
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
-        cnt_pos = float(jnp.sum(self.label > 0))
+        cnt_pos = float((self._label_np > 0).sum())
         cnt_neg = float(num_data - cnt_pos)
         if self.is_unbalance and cnt_pos > 0 and cnt_neg > 0:
             # weight the smaller class up (binary_objective.hpp Init)
@@ -300,11 +313,7 @@ class BinaryLogloss(ObjectiveFunction):
 
     def boost_from_score(self):
         # avg label -> logit / sigmoid (binary_objective.hpp BoostFromScore)
-        if self.weight is not None:
-            pavg = float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
-        else:
-            pavg = float(jnp.mean(self.label))
-        pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
+        pavg = min(max(self._host_label_mean(), 1e-15), 1.0 - 1e-15)
         return np.log(pavg / (1.0 - pavg)) / self.sigmoid
 
     def convert_output(self, score):
@@ -326,7 +335,7 @@ class MulticlassSoftmax(ObjectiveFunction):
         self.num_model_per_iteration = self.num_class
 
     def _check_label(self):
-        lab = np.asarray(self.label)
+        lab = self._label_np
         if lab.min() < 0 or lab.max() >= self.num_class:
             raise ValueError(
                 f"multiclass labels must be in [0, {self.num_class})")
@@ -382,7 +391,7 @@ class CrossEntropy(ObjectiveFunction):
     name = "xentropy"
 
     def _check_label(self):
-        lab = np.asarray(self.label)
+        lab = self._label_np
         if lab.min() < 0 or lab.max() > 1:
             raise ValueError("xentropy labels must be in [0, 1]")
 
@@ -393,11 +402,7 @@ class CrossEntropy(ObjectiveFunction):
         return _apply_weight(grad, hess, self.weight)
 
     def boost_from_score(self):
-        if self.weight is not None:
-            pavg = float(jnp.sum(self.label * self.weight) / jnp.sum(self.weight))
-        else:
-            pavg = float(jnp.mean(self.label))
-        pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
+        pavg = min(max(self._host_label_mean(), 1e-15), 1.0 - 1e-15)
         return float(np.log(pavg / (1.0 - pavg)))
 
     def convert_output(self, score):
@@ -422,7 +427,7 @@ class CrossEntropyLambda(ObjectiveFunction):
         return grad, hess
 
     def boost_from_score(self):
-        pavg = float(jnp.mean(self.label))
+        pavg = float(self._label_np.mean())
         pavg = min(max(pavg, 1e-15), 1.0 - 1e-15)
         return float(np.log(-np.log1p(-pavg)))
 
@@ -460,7 +465,7 @@ class LambdarankNDCG(ObjectiveFunction):
         idx = np.where(valid, idx, 0)
         self.q_idx = jnp.asarray(idx, jnp.int32)
         self.q_valid = jnp.asarray(valid)
-        labels = np.asarray(self.label)
+        labels = self._label_np
         lab = np.where(valid, labels[idx], -1)
         # inverse max DCG per query at truncation max_position
         # (rank_objective.hpp Init :46-73)

@@ -176,6 +176,20 @@ def transpose_bins(bins: jnp.ndarray, row_tile: int = DEFAULT_ROW_TILE,
         out, bins.T.astype(jnp.uint8), (0, 0))
 
 
+def transpose_bins_host(bins: "np.ndarray", row_tile: int = DEFAULT_ROW_TILE,
+                        feat_tile: int | None = None) -> "np.ndarray":
+    """Host (numpy) twin of :func:`transpose_bins` — same padding layout.
+    Used at booster init on small datasets, where the jitted transpose's
+    one-time compile costs more than the extra host->device copy."""
+    import numpy as np
+    n, F = bins.shape
+    n_pad = _round_up(n, row_tile)
+    F_pad = _round_up(F, feat_tile or F)
+    out = np.zeros((F_pad, n_pad), np.uint8)
+    out[:F, :n] = np.asarray(bins, np.uint8).T
+    return out
+
+
 def pack_values(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
                 row_tile: int = DEFAULT_ROW_TILE) -> jnp.ndarray:
     """Build the per-row value rows ``[C, n_pad]`` once per tree.

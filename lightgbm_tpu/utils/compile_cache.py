@@ -1,0 +1,38 @@
+"""Persistent XLA compilation cache (opt-in).
+
+Cold-start on a (remote) TPU is dominated by XLA compile time, not FLOPs
+— a 100-iteration run on a small dataset is ~1.5 s of device work behind
+~30 s of one-time compilation.  JAX's persistent compilation cache can
+replay compiled executables across processes, keyed by (program, jaxlib
+version, backend fingerprint).
+
+Opt-in via ``LGBM_TPU_COMPILE_CACHE=<dir>`` rather than on by default:
+measured on the axon-tunneled TPU backend, the backend fingerprint
+changes per process, so every lookup misses and the run *also* pays
+executable serialization (~40 s -> ~70-100 s).  On local CPU/TPU
+backends with stable fingerprints it behaves as intended; set the env
+var there.  A user who already configured ``jax_compilation_cache_dir``
+is left alone.
+"""
+from __future__ import annotations
+
+import os
+
+_DISABLE = {"", "0", "off", "false", "no"}
+
+
+def enable_default_compile_cache() -> None:
+    spec = os.environ.get("LGBM_TPU_COMPILE_CACHE", "")
+    if spec.strip().lower() in _DISABLE:
+        return
+    try:
+        import jax
+        if jax.config.jax_compilation_cache_dir:
+            return                      # user already configured one
+        os.makedirs(spec, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", spec)
+        # cache even fast compiles: the block program's cost is the sum
+        # of many medium-sized waves
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
+    except Exception:                   # noqa: BLE001 - cache is best-effort
+        pass
