@@ -748,17 +748,20 @@ class GBDT:
             return fn
         obj = self.objective
         growth = self.growth
-        dd = self.device_data
-        bins_t = self._bins_t
         K = self.num_tree_per_iteration
         c = self.config
         n = self.num_data
-        F = dd.num_features
+        F = self.device_data.num_features
         bag_on = c.bagging_freq > 0 and c.bagging_fraction < 1.0
         ff_on = c.feature_fraction < 1.0
         kf = max(1, int(c.feature_fraction * F))
 
-        def block(scores, lr, it0, n_active):
+        # dd/bins_t are ARGUMENTS, not closures: closed-over device
+        # arrays embed as constants in the compile payload — 28 MB of
+        # bins at 1M rows made every remote compile ship a ~32 MB
+        # program, and a 10.5M-row store (294 MB) overflowed the compile
+        # tunnel's request limit outright (HTTP 413)
+        def block(dd, bins_t, scores, lr, it0, n_active):
             def body(scores, it):
                 active = it - it0 < n_active
                 scores_in = scores
@@ -781,7 +784,8 @@ class GBDT:
                              if ff_on else None)
                     bt = build_tree(dd, G[:, k], H[:, k], growth,
                                     bag_mask=bag, feature_mask=fmask,
-                                    bins_t=bins_t)
+                                    bins_t=bins_t,
+                                    hist_mode=c.hist_mode or None)
                     lv = jnp.where(bt.num_leaves > 1, bt.leaf_value,
                                    jnp.zeros_like(bt.leaf_value))
                     bt = bt._replace(leaf_value=lv)
@@ -836,7 +840,26 @@ class GBDT:
         splittable leaves)."""
         from ..utils.timetag import tag
         done = 0
-        while done < num_iters:
+        K = self.num_tree_per_iteration
+        c = self.config
+        # stump-stop checks are OVERLAPPED: each block's last-iteration
+        # leaf count is fetched asynchronously and inspected one block
+        # later, so the device never idles a tunnel round-trip between
+        # blocks (~120 ms each, ~12% of a 32-iteration block at 1M rows).
+        # When a late check fires, the one extra dispatched block is all
+        # stumps (zero score contribution) and is rolled back whole.
+        # Valid ONLY when gradients are the sole per-iteration input: a
+        # stump leaves scores (hence gradients) unchanged, so every
+        # later iteration reproduces the stump.  Bagging/feature-
+        # fraction resample per iteration/tree and CAN grow real trees
+        # after a stump — those configs resolve each check immediately
+        # (review r4 finding: a rolled-back real tree would leave its
+        # score contribution behind).
+        speculate = ((c.bagging_freq <= 0 or c.bagging_fraction >= 1.0)
+                     and c.feature_fraction >= 1.0)
+        prev_check = None                  # pending num_leaves slice
+        stopped = False
+        while done < num_iters and not stopped:
             if not self._can_block():
                 # unsupported config: per-iteration path
                 if self.train_one_iter():
@@ -846,12 +869,12 @@ class GBDT:
             nb = min(num_iters - done, self._BLOCK_CAP)
             fn = self._block_fn(self._pick_block_len(nb))
             with tag("block") as tdone:
-                self.scores, trees = fn(self.scores,
+                self.scores, trees = fn(self.device_data, self._bins_t,
+                                        self.scores,
                                         jnp.float32(self.shrinkage_rate),
                                         jnp.int32(self.iter),
                                         jnp.int32(nb))
                 tdone(trees.num_leaves)
-            K = self.num_tree_per_iteration
             # init-score bias rides the pending entry and is baked into
             # the first K host trees at flush (no separate per-iteration
             # bias-bake dispatch, which cost a whole extra XLA program)
@@ -862,16 +885,38 @@ class GBDT:
             self.iter += nb
             self._stacked_cache = None
             done += nb
-            # stump stop: ONE tiny fetch per block (vs per iteration)
-            last_nl = np.atleast_1d(jax.device_get(trees.num_leaves[nb - 1]))
-            if all(int(x) <= 1 for x in last_nl):
-                self.trim_trailing_stumps()
-                log_warning(
-                    "stopped training because there are no more leaves "
-                    f"that meet the split requirements (iteration "
-                    f"{self.iter + 1})")
-                return True
-        return False
+            nl = trees.num_leaves[nb - 1]
+            if not speculate:
+                stopped = self._check_block_stump(nl, rollback=0)
+                continue
+            try:
+                nl.copy_to_host_async()
+            except Exception:              # noqa: BLE001 - CPU backends
+                pass
+            if prev_check is not None:
+                stopped = self._check_block_stump(prev_check, rollback=1)
+            prev_check = nl
+        if not stopped and prev_check is not None:
+            stopped = self._check_block_stump(prev_check, rollback=0)
+        return stopped
+
+    def _check_block_stump(self, nl, rollback: int) -> bool:
+        """Resolve an async stump check; on stop, drop the last
+        ``rollback`` pending blocks (dispatched before the check
+        resolved — all stumps, zero score contribution)."""
+        last_nl = np.atleast_1d(jax.device_get(nl))
+        if not all(int(x) <= 1 for x in last_nl):
+            return False
+        K = max(1, self.num_tree_per_iteration)
+        for _ in range(min(rollback, len(self._pending))):
+            _, _, _, cnt = self._pending.pop()
+            self.iter -= cnt // K
+        self.trim_trailing_stumps()
+        log_warning(
+            "stopped training because there are no more leaves "
+            f"that meet the split requirements (iteration "
+            f"{self.iter + 1})")
+        return True
 
     # ------------------------------------------------------------------
     def train(self, num_iterations: Optional[int] = None,
@@ -996,16 +1041,10 @@ class GBDT:
         # decision at once + one path-agreement contraction — no gathers,
         # no depth loop.  The gather walk serializes depth x trees x rows
         # (minutes at 500 deep trees x 2e5 rows; long dispatches fault
-        # the TPU worker).  Gated: numerical splits, bin ids <= 256
-        # (bf16-exact through the MXU), unbundled columns.
-        # bin IDS consulted are <= max_bins (numeric bins <= num_bin-1;
-        # the categorical sentinel path is excluded by the num_cat gate),
-        # all bf16-exact up to 256 — the mask width (max_bins+2) is NOT
-        # the bound
-        use_matmul = (not bundle_kw
-                      and dd.max_bins <= 256
-                      and not any(self.models[i].num_cat > 0
-                                  for i in range(T)))
+        # the TPU worker).  Covers categorical splits (vectorized bitset
+        # lookup) and >256-bin ids (f32 select einsums) since r4; only
+        # EFB-bundled columns still take the chunked walk.
+        use_matmul = not bundle_kw
         from ..models.tree import (build_path_matrices, predict_binned_matmul,
                                    predict_binned_chunked)
         tchunk = int(_os.environ.get("LGBM_TPU_PRED_TREE_CHUNK",

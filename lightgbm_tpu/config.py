@@ -438,7 +438,8 @@ class Config:
             raise ValueError(f"unknown tree_learner {self.tree_learner!r}")
         if self.growth_mode not in ("wave", "leafwise"):
             raise ValueError(f"unknown growth_mode {self.growth_mode!r}")
-        if self.hist_mode not in ("", "bf16", "ghilo", "hhilo", "hilo"):
+        if self.hist_mode not in ("", "bf16", "ghilo", "hhilo", "hilo",
+                                  "int8", "int8h"):
             raise ValueError(f"unknown hist_mode {self.hist_mode!r}")
         # gpu_use_dp is the reference's GPU double-precision knob
         # (docs/GPU-Performance.rst): honor it as "use the high-precision

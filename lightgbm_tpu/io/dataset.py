@@ -446,11 +446,11 @@ class BinnedDataset:
         #    (reference FastFeatureBundling, dataset.cpp:138-210)
         ds.bundle = None
         used_mappers = [mappers[f] for f in ds.used_features]
-        # feature-parallel slices logical feature columns; bundling would
-        # interleave them, so skip EFB for that learner
+        # (feature-parallel composes since r4: each shard gathers its
+        # features' group columns — reference bundles identically on
+        # every rank for all learner types, dataset.cpp:138-210)
         if (allow_bundle and config.enable_bundle
-                and len(ds.used_features) >= 2
-                and config.tree_learner != "feature"):
+                and len(ds.used_features) >= 2):
             n_sparse = sum(m.sparse_rate >= config.sparse_threshold
                            and m.num_bin > 1 for m in used_mappers)
             if n_sparse >= 2:

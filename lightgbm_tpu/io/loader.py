@@ -180,82 +180,160 @@ def _column_plan(ncol: int, config: Config, header_names):
     return label_idx, weight_idx, query_idx, keep, names, cat_cols
 
 
-def load_file_two_round(path: str, config: Config) -> "BinnedDataset":
+def load_file_two_round(path: str, config: Config, rank: int = 0,
+                        num_machines: int = 1,
+                        allgather=None) -> "BinnedDataset":
     """Two-round low-memory ingest (reference `dataset_loader.cpp:698-742`
     + `utils/pipeline_reader.h:26+`): round 1 streams bounded chunks to
-    collect the bin-finding sample (row count via a raw newline scan, so
-    the sample indices MATCH the in-memory path's RNG draw — byte-
-    identical mappers); round 2 streams again, binning each chunk
-    straight into the packed uint16 column store.  Peak memory is the
-    binned matrix plus one chunk — the raw float64 matrix (8 bytes/cell)
-    never exists.
+    collect the bin-finding sample (row count via a raw scan, so the
+    sample indices MATCH the in-memory path's RNG draw — byte-identical
+    mappers); round 2 streams again, binning each chunk straight into
+    the packed column store.  Peak memory is the binned matrix plus one
+    chunk — the raw float64 matrix (8 bytes/cell) never exists.
+
+    Formats: CSV/TSV (delimited chunks) and LibSVM (chunked sparse
+    parse; the native layer emits [rows, 1+F] with the label in column
+    0, so the delimited machinery applies unchanged).
+
+    Distributed (``num_machines > 1``): mod-rank row sharding composes
+    by index arithmetic — this rank keeps global rows ``r ≡ rank (mod
+    S)`` from the same chunk stream, the bin-finding sample is drawn
+    over the LOCAL shard with the same per-rank RNG as the in-memory
+    path (`find_bins_distributed`), and the sampled rows feed the same
+    feature-sharded mapper allgather, so every rank bins identically
+    (VERDICT r3 #9; reference `dataset_loader.cpp:639-742`).
     """
     from .. import native
     path = localize(path)
     fmt = detect_format(path, config.has_header)
-    sep = {"csv": ",", "tsv": "\t"}[fmt]
     header_names = None
-    skip = 0
-    if config.has_header:
-        with open(path) as f:
-            header_names = f.readline().rstrip("\n").split(sep)
-        skip = 1
+    skip = 1 if config.has_header else 0
+    S = max(1, num_machines)
+    # pre-partition: each rank already has its own file — keep every
+    # row, but bin finding still runs feature-sharded across ranks
+    stride = 1 if (S > 1 and config.is_pre_partition) else S
 
-    # round 0: data row count via a raw scan (no parsing; bounded reads).
-    # Blank lines are NOT rows — the chunk parser skips them, and the
-    # count must match or the sample-index draw shifts.
-    n = 0
-    pending = False          # current line has non-whitespace content
-    with open(path, "rb") as f:
-        while True:
-            chunk = f.read(4 << 20)
-            if not chunk:
-                break
-            filtered = chunk.translate(None, delete=b"\r \t")
-            arr = np.frombuffer(filtered, np.uint8)
-            nls = np.flatnonzero(arr == 10)
-            if len(nls):
-                gaps = np.diff(np.concatenate([[-1], nls])) > 1
-                if nls[0] == 0 and pending:
-                    gaps[0] = True       # line continued from prior chunk
-                n += int(gaps.sum())
-                pending = bool(len(arr) - 1 - nls[-1] > 0)
-            else:
-                pending = pending or len(arr) > 0
-    if pending:
-        n += 1                          # unterminated final line
-    n -= skip
+    if fmt == "libsvm":
+        scanned = native.scan_libsvm(path, skip)
+        if scanned is None:
+            raise ValueError("native libsvm scan failed")
+        n, fcols = scanned
+        if S > 1:
+            # every rank must bin against the same column count
+            fcols = max(int(c) for c in allgather(int(fcols)))
+        ncol = fcols + 1                 # + label column 0
+        chunk_bytes = 4 << 20
+
+        def chunk_stream():
+            return native.parse_libsvm_chunks(path, skip, fcols,
+                                              chunk_bytes=chunk_bytes)
+    else:
+        sep = {"csv": ",", "tsv": "\t"}[fmt]
+        if config.has_header:
+            with open(path) as f:
+                header_names = f.readline().rstrip("\n").split(sep)
+
+        # round 0: data row count via a raw scan (no parsing; bounded
+        # reads).  Blank lines are NOT rows — the chunk parser skips
+        # them, and the count must match or the sample-index draw shifts.
+        n = 0
+        pending = False      # current line has non-whitespace content
+        with open(path, "rb") as f:
+            while True:
+                chunk = f.read(4 << 20)
+                if not chunk:
+                    break
+                filtered = chunk.translate(None, delete=b"\r \t")
+                arr = np.frombuffer(filtered, np.uint8)
+                nls = np.flatnonzero(arr == 10)
+                if len(nls):
+                    gaps = np.diff(np.concatenate([[-1], nls])) > 1
+                    if nls[0] == 0 and pending:
+                        gaps[0] = True   # line continued from prior chunk
+                    n += int(gaps.sum())
+                    pending = bool(len(arr) - 1 - nls[-1] > 0)
+                else:
+                    pending = pending or len(arr) > 0
+        if pending:
+            n += 1                      # unterminated final line
+        n -= skip
+        ncol = None
+        chunk_bytes = 4 << 20           # bounded: ~4 MB text per chunk
+
+        def chunk_stream():
+            return native.parse_delimited_chunks(path, sep, skip,
+                                                 chunk_bytes=chunk_bytes)
     if n <= 0:
         raise ValueError(f"no data rows in {path!r}")
+    n_full = n
+    # fail BEFORE streaming the whole file: a group column means ranking
+    # queries, which mod-rank sharding would split
+    if config.group_column and stride > 1:
+        raise ValueError(
+            "mod-rank row sharding would split ranking queries; use "
+            "is_pre_partition=true with per-rank files (reference "
+            "dataset_loader.cpp:639-742 contract)")
 
-    # the same sample-index draw as BinnedDataset.from_raw
-    sample_cnt = min(n, config.bin_construct_sample_cnt)
-    rng = np.random.RandomState(config.data_random_seed)
-    sample_idx = (np.arange(n) if sample_cnt >= n
-                  else np.sort(rng.choice(n, sample_cnt, replace=False)))
+    # local shard: global rows rank, rank+stride, ... (mod-rank,
+    # matching the in-memory distributed path); stride == 1 keeps all
+    local_n = len(range(rank % stride if stride > 1 else 0, n, stride))
+    # sample draw: global RNG single-machine (byte-identical mappers),
+    # per-rank RNG over the local shard under distribution (matching
+    # find_bins_distributed's own draw)
+    if S == 1:
+        sample_cnt = min(n, config.bin_construct_sample_cnt)
+        rng = np.random.RandomState(config.data_random_seed)
+        local_sample = (np.arange(n) if sample_cnt >= n
+                        else np.sort(rng.choice(n, sample_cnt,
+                                                replace=False)))
+        sample_gidx = local_sample
+    else:
+        sample_cnt = min(local_n, config.bin_construct_sample_cnt)
+        rng = np.random.RandomState(config.data_random_seed + rank)
+        local_sample = (np.arange(local_n) if sample_cnt >= local_n
+                        else np.sort(rng.choice(local_n, sample_cnt,
+                                                replace=False)))
+        sample_gidx = (local_sample if stride == 1
+                       else rank + local_sample * stride)  # sorted affine
 
     # round 1: stream chunks, keep only sampled rows
-    chunk_bytes = 4 << 20                  # bounded: ~4 MB text per chunk
     sample_rows = []
     base = 0
     plan = None
-    for chunk in native.parse_delimited_chunks(path, sep, skip,
-                                               chunk_bytes=chunk_bytes):
+    for chunk in chunk_stream():
         if plan is None:
             plan = _column_plan(chunk.shape[1], config, header_names)
-        lo = np.searchsorted(sample_idx, base)
-        hi = np.searchsorted(sample_idx, base + len(chunk))
+        lo = np.searchsorted(sample_gidx, base)
+        hi = np.searchsorted(sample_gidx, base + len(chunk))
         if hi > lo:
-            sample_rows.append(chunk[sample_idx[lo:hi] - base])
+            sample_rows.append(chunk[sample_gidx[lo:hi] - base])
         base += len(chunk)
     if base != n:
         raise ValueError(
-            f"chunked parse saw {base} rows, newline scan counted {n}")
+            f"chunked parse saw {base} rows, raw scan counted {n}")
     label_idx, weight_idx, query_idx, keep, names, cat_cols = plan
+    if query_idx is not None and stride > 1:
+        raise ValueError(
+            "mod-rank row sharding would split ranking queries; use "
+            "is_pre_partition=true with per-rank files (reference "
+            "dataset_loader.cpp:639-742 contract)")
     sample = np.concatenate(sample_rows)[:, keep]
 
     from .dataset import BinnedDataset, find_mappers_from_sample
-    mappers = find_mappers_from_sample(sample, config, set(cat_cols))
+    if S > 1:
+        # the sampled local rows ARE find_bins_distributed's own draw
+        # (same rng, len == sample_cnt -> it uses every row), so the
+        # feature-sharded mapper allgather matches the in-memory
+        # distributed path exactly
+        from .distributed import find_bins_distributed
+        mappers = find_bins_distributed(sample, config, rank, S,
+                                        allgather, cat_cols)
+        if len(mappers) < sample.shape[1]:
+            keep = keep[:len(mappers)]
+            names = names[:len(mappers)]
+            cat_cols = [c for c in cat_cols if c < len(mappers)]
+    else:
+        mappers = find_mappers_from_sample(sample, config, set(cat_cols))
     del sample, sample_rows
     used = [f for f in range(len(keep)) if not mappers[f].is_trivial]
 
@@ -263,24 +341,36 @@ def load_file_two_round(path: str, config: Config) -> "BinnedDataset":
     # SAME dtype _pack_columns would choose so the matrix can be adopted
     # without a copy when EFB doesn't engage
     max_nb = max((mappers[f].num_bin for f in used), default=2)
-    prebinned = np.zeros((n, len(used)),
+    prebinned = np.zeros((local_n, len(used)),
                          np.uint8 if max_nb <= 256 else np.int32)
-    label = np.zeros(n, np.float32)
-    weight = np.zeros(n, np.float32) if weight_idx is not None else None
-    query = np.zeros(n, np.float64) if query_idx is not None else None
-    base = 0
-    for chunk in native.parse_delimited_chunks(path, sep, skip,
-                                               chunk_bytes=chunk_bytes):
-        m = len(chunk)
-        label[base:base + m] = chunk[:, label_idx]
+    label = np.zeros(local_n, np.float32)
+    weight = np.zeros(local_n, np.float32) if weight_idx is not None else None
+    query = np.zeros(local_n, np.float64) if query_idx is not None else None
+    base = 0       # global row index at chunk start
+    lbase = 0      # local (this-rank) rows written so far
+    for chunk in chunk_stream():
+        if stride > 1:
+            first = (-(base - rank) % stride)     # first local row offset
+            sel = np.arange(first, len(chunk), stride)
+            chunk_loc = chunk[sel]
+        else:
+            chunk_loc = chunk
+        m = len(chunk_loc)
+        label[lbase:lbase + m] = chunk_loc[:, label_idx]
         if weight is not None:
-            weight[base:base + m] = chunk[:, weight_idx]
+            weight[lbase:lbase + m] = chunk_loc[:, weight_idx]
         if query is not None:
-            query[base:base + m] = chunk[:, query_idx]
+            query[lbase:lbase + m] = chunk_loc[:, query_idx]
         for j, f in enumerate(used):
-            prebinned[base:base + m, j] = mappers[f].value_to_bin(
-                chunk[:, keep[f]])
-        base += m
+            prebinned[lbase:lbase + m, j] = mappers[f].value_to_bin(
+                chunk_loc[:, keep[f]])
+        base += len(chunk)
+        lbase += m
+    if lbase != local_n:
+        raise ValueError(
+            f"sharded chunk stream yielded {lbase} rows, expected "
+            f"{local_n}")
+    n = local_n
     from ..utils.file_io import release
     release(path)
 
@@ -301,11 +391,14 @@ def load_file_two_round(path: str, config: Config) -> "BinnedDataset":
     ds.used_features = used
     cols = [prebinned[:, j] for j in range(len(used))]
     empty_X = np.zeros((n, 0))
-    ds = BinnedDataset._finish_from_mappers(ds, empty_X, config, md, n,
-                                            len(keep), cols=cols,
-                                            packed=prebinned)
-    log_info(f"two-round loading: {n} rows streamed, peak holds the "
-             f"binned store only")
+    ds = BinnedDataset._finish_from_mappers(
+        ds, empty_X, config, md, n, len(keep), cols=cols, packed=prebinned,
+        allow_bundle=(S == 1 or allgather is not None),
+        bundle_allgather=(allgather if S > 1 else None), rank=rank)
+    ds._global_rows = n_full    # pre-shard row count (side-file slicing)
+    log_info(f"two-round loading: {n} rows streamed"
+             + (f" (rank {rank}/{S})" if S > 1 else "")
+             + ", peak holds the binned store only")
     return ds
 
 
@@ -364,28 +457,55 @@ def load_file(path: str, config: Config,
     # (reference dataset_loader.cpp:698-742; HIGGS peak-RAM contract,
     # docs/Experiments.rst:156-160)
     if config.use_two_round_loading:
-        if reference is not None or num_machines > 1:
+        if num_machines > 1 and allgather is None:
+            from .distributed import external_collectives
+            ext = external_collectives()
+            if ext is not None:
+                allgather = ext.allgather
+        if reference is not None or (num_machines > 1 and allgather is None):
             log_warning("use_two_round_loading is ignored for aligned "
-                        "valid sets and distributed loading; using the "
-                        "in-memory path")
+                        "valid sets (and distributed loading without a "
+                        "collective backend); using the in-memory path")
         else:
             from .. import native
             from ..utils.file_io import release
             local = localize(path)      # ONE download; reused below
             fmt = detect_format(local, config.has_header)
-            if fmt in ("csv", "tsv") and native.available():
+            if fmt in ("csv", "tsv", "libsvm") and native.available():
                 try:
-                    ds = load_file_two_round(local, config)
+                    ds = load_file_two_round(local, config, rank=rank,
+                                             num_machines=num_machines,
+                                             allgather=allgather)
                 finally:
                     release(local)
+                # side files are GLOBAL-length: under mod-rank sharding
+                # they must be sliced to this rank's rows exactly like
+                # the in-memory path does (review r4: attaching the full
+                # array silently weighted rows by the wrong entries)
+                n_full = getattr(ds, "_global_rows", ds.num_data)
+                sharded = n_full != ds.num_data
                 w2 = _load_side_file(path + ".weight")
                 if w2 is not None:
+                    if sharded:
+                        w2 = w2[rank::num_machines]
                     ds.metadata.set_field("weight", w2)
                 init2 = _load_side_file(path + ".init", np.float64)
                 if init2 is not None:
+                    if sharded:
+                        # flat [n_full * K] in class-major blocks
+                        K = max(1, len(init2) // n_full)
+                        sel = np.arange(rank, n_full, num_machines)
+                        init2 = np.concatenate(
+                            [init2[k * n_full + sel] for k in range(K)])
                     ds.metadata.set_field("init_score", init2)
                 q2 = _load_side_file(path + ".query", np.int64)
                 if q2 is not None:
+                    if sharded:
+                        raise ValueError(
+                            "mod-rank row sharding would split ranking "
+                            "queries; use is_pre_partition=true with "
+                            "per-rank files (reference "
+                            "dataset_loader.cpp:639-742 contract)")
                     ds.metadata.set_field("group", q2.astype(np.int32))
                 if config.is_save_binary_file and is_local:
                     ds.save_binary(bin_path[:-4])

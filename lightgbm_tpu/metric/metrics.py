@@ -172,24 +172,29 @@ def binary_auc(label, score, weight=None):
     the parity tooling."""
     label = np.asarray(label)
     score = np.asarray(score)
+    if len(label) == 0:
+        return 1.0       # degenerate input: same value as the all-one-
+    #                      class guard below (reduceat rejects empty)
     order = np.argsort(score, kind="mergesort")
     s = score[order]
     y = label[order]
-    w = weight[order] if weight is not None else np.ones_like(y)
+    # f64 throughout: the rank-sum area is O(n^2/4) — ~2.7e13 at 10.5M
+    # rows, far past f32's 24-bit integer range (a f32 accumulation
+    # returned AUC > 1 on the full-scale bench leg)
+    w = (weight[order].astype(np.float64) if weight is not None
+         else np.ones(len(y), np.float64))
     wp = w * (y > 0)
     wn = w * (y <= 0)
     # group ties: average rank treatment via per-tie-block trapezoid
-    # cumulative negatives BEFORE each block + half within block
+    # cumulative negatives BEFORE each block + half within block —
+    # vectorized with reduceat (continuous scores mean ~n blocks; a
+    # Python block loop took minutes at 10.5M rows)
     boundaries = np.nonzero(np.diff(s))[0]
     starts = np.concatenate([[0], boundaries + 1])
-    ends = np.concatenate([boundaries + 1, [len(s)]])
-    cum_neg = 0.0
-    area = 0.0
-    for a, b in zip(starts, ends):
-        bp = wp[a:b].sum()
-        bn = wn[a:b].sum()
-        area += bp * (cum_neg + 0.5 * bn)
-        cum_neg += bn
+    bp = np.add.reduceat(wp, starts)
+    bn = np.add.reduceat(wn, starts)
+    cum_before = np.concatenate([[0.0], np.cumsum(bn)[:-1]])
+    area = float(np.sum(bp * (cum_before + 0.5 * bn)))
     total_pos = wp.sum()
     total_neg = wn.sum()
     if total_pos == 0 or total_neg == 0:

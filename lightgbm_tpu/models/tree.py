@@ -596,19 +596,23 @@ def predict_binned_matmul(stacked: StackedTrees,
     decision is evaluated at once and the leaf emerges from one
     path-agreement contraction — no gathers, no depth loop:
 
-      * ``c  = onehot(split_feature) @ bins^T``  (each node's bin value)
+      * ``c  = onehot(split_feature) @ bins^T``  (each node's bin value;
+        f32 operands, so bin ids past 256 stay exact — reference
+        prediction covers all bin widths uniformly, tree.h:112+),
       * per-node missing metadata via the same one-hot against the
         per-feature tables,
-      * ``d2 = +-1`` decisions, ``S = P @ d2``; a row lands in leaf l
-        iff ``S[l] == pathlen[l]`` (exact: all values are small ints,
-        bf16-exact through the MXU, f32-accumulated),
+      * ``d2 = +-1`` decisions — numerical by threshold compare,
+        categorical by one vectorized in-VMEM lookup into the per-node
+        left-bin bitset ``cat_bin_mask`` (same semantics as the walk:
+        the bitset decides, missing bins simply aren't in the set),
+      * ``S = P @ d2``; a row lands in leaf l iff ``S[l] == pathlen[l]``
+        (exact: ±1 products, f32 MXU accumulation),
       * output = leaf one-hot contracted with leaf values (hi+lo bf16
         pair for ~f32 accuracy).
 
     ``lax.map`` over (tree-chunk, row-block) keeps the ``[tc, M, rc]``
     intermediates bounded inside ONE compiled program.  Callers gate:
-    no categorical splits, bin ids (incl. the prediction-mode sentinel)
-    <= 256, unbundled columns.
+    unbundled columns only (EFB models take the chunked walk).
     """
     T, L = plen.shape
     M = P.shape[2]
@@ -625,6 +629,7 @@ def predict_binned_matmul(stacked: StackedTrees,
         return jnp.concatenate(
             [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)])
 
+    any_cat = stacked.cat_bin_mask.shape[2] > 1   # B=1 when no cat splits
     chunks = {
         "sf": padT(stacked.split_feature, 0),
         "tb": padT(stacked.threshold_bin, 0),
@@ -633,6 +638,9 @@ def predict_binned_matmul(stacked: StackedTrees,
         "P": padT(jnp.asarray(P), 0),
         "plen": padT(jnp.asarray(plen), -1),   # -1: never matches
     }
+    if any_cat:
+        chunks["ic"] = padT(stacked.is_categorical, False)
+        chunks["cm"] = padT(stacked.cat_bin_mask, False)
     chunks = {k: v.reshape((TC, tc) + v.shape[1:])
               for k, v in chunks.items()}
 
@@ -651,14 +659,17 @@ def predict_binned_matmul(stacked: StackedTrees,
     def row_block(blk):                                   # [F, rc]
         def tree_chunk(c):
             sf = c["sf"]                                  # [tc, M]
+            # f32 one-hot selects: bin ids (and the sentinel) stay exact
+            # past 256, unlike bf16 operands; the select einsums are a
+            # rounding error of the path contraction's FLOPs
             ohSF = (sf[:, :, None]
-                    == jnp.arange(F)[None, None, :]).astype(jnp.bfloat16)
-            cc = jnp.einsum("tmf,fr->tmr", ohSF,
-                            blk.astype(jnp.bfloat16),
-                            preferred_element_type=jnp.float32)
-            meta = jnp.einsum("tmf,fk->tmk", ohSF,
-                              fmeta.astype(jnp.bfloat16),
-                              preferred_element_type=jnp.float32)
+                    == jnp.arange(F)[None, None, :]).astype(jnp.float32)
+            cc = jnp.einsum("tmf,fr->tmr", ohSF, blk,
+                            preferred_element_type=jnp.float32,
+                            precision=jax.lax.Precision.HIGHEST)
+            meta = jnp.einsum("tmf,fk->tmk", ohSF, fmeta,
+                              preferred_element_type=jnp.float32,
+                              precision=jax.lax.Precision.HIGHEST)
             nanb = meta[:, :, 0:1]
             db = meta[:, :, 1:2]
             mt = meta[:, :, 2:3]
@@ -666,6 +677,13 @@ def predict_binned_matmul(stacked: StackedTrees,
                           | ((mt == float(MISSING_ZERO)) & (cc == db)))
             tb = c["tb"].astype(jnp.float32)[:, :, None]
             dec = jnp.where(is_missing, c["dl"][:, :, None], cc <= tb)
+            if any_cat:
+                # categorical: one vectorized in-VMEM bitset lookup per
+                # node (walk semantics — the bitset alone decides)
+                Bc = c["cm"].shape[2]
+                idx = jnp.minimum(cc.astype(jnp.int32), Bc - 1)
+                dec_cat = jnp.take_along_axis(c["cm"], idx, axis=2)
+                dec = jnp.where(c["ic"][:, :, None], dec_cat, dec)
             d2 = jnp.where(dec, 1.0, -1.0).astype(jnp.bfloat16)
             S = jnp.einsum("tlm,tmr->tlr",
                            c["P"].astype(jnp.bfloat16), d2,

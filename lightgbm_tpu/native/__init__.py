@@ -61,6 +61,16 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
             ctypes.POINTER(ctypes.c_long),
             ctypes.POINTER(ctypes.c_longlong)]
+        lib.ltpu_scan_libsvm.restype = ctypes.c_long
+        lib.ltpu_scan_libsvm.argtypes = [
+            ctypes.c_char_p, ctypes.c_long,
+            ctypes.POINTER(ctypes.c_long)]
+        lib.ltpu_parse_libsvm_chunk.restype = ctypes.c_long
+        lib.ltpu_parse_libsvm_chunk.argtypes = [
+            ctypes.c_char_p, ctypes.c_longlong, ctypes.c_long,
+            ctypes.c_long, ctypes.c_long,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_double)),
+            ctypes.POINTER(ctypes.c_longlong)]
         lib.ltpu_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
         _lib = lib
     except Exception:
@@ -127,6 +137,49 @@ def parse_delimited_chunks(path: str, delim: str, skip: int,
         if rows > 0:
             expect_cols = int(cols.value)
             yield _take(lib, data, (int(rows), expect_cols))
+        if int(nxt.value) <= offset:
+            break
+        offset = int(nxt.value)
+
+
+def scan_libsvm(path: str, skip: int):
+    """Bounded-memory LibSVM scan -> (data rows, num feature columns),
+    or None when the native parser is unavailable."""
+    lib = _load()
+    if lib is None:
+        return None
+    max_idx = ctypes.c_long()
+    rows = lib.ltpu_scan_libsvm(path.encode(), skip, ctypes.byref(max_idx))
+    if rows < 0:
+        return None
+    return int(rows), int(max_idx.value) + 1
+
+
+def parse_libsvm_chunks(path: str, skip: int, cols: int,
+                        chunk_bytes: int = 8 << 20):
+    """Generator of bounded-memory ``[rows, 1 + cols]`` float64 chunks
+    (label in column 0) — the LibSVM twin of
+    :func:`parse_delimited_chunks`."""
+    lib = _load()
+    if lib is None:
+        return
+    offset = 0
+    size = os.path.getsize(path)
+    while offset < size:
+        data = ctypes.POINTER(ctypes.c_double)()
+        nxt = ctypes.c_longlong()
+        rows = lib.ltpu_parse_libsvm_chunk(
+            path.encode(), offset, skip, chunk_bytes, cols,
+            ctypes.byref(data), ctypes.byref(nxt))
+        if rows == -4:
+            chunk_bytes *= 4
+            continue
+        if rows < 0:
+            raise ValueError(
+                f"native chunked libsvm parse failed on {path!r} "
+                f"(code {rows})")
+        if rows > 0:
+            yield _take(lib, data, (int(rows), cols + 1))
         if int(nxt.value) <= offset:
             break
         offset = int(nxt.value)

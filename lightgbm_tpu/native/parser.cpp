@@ -289,6 +289,153 @@ long ltpu_parse_delimited_chunk(const char* path, char delim,
   return rows;
 }
 
+// Bounded-memory LibSVM scan: data row count + max feature index
+// (the two-round flow's round 0 — the whole file is never resident).
+// Row semantics match ltpu_parse_libsvm's pass 1: any line that is not
+// purely \n/\r counts.  Returns rows (<0 on error), *out_max_idx = -1
+// when no "idx:" token exists.
+long ltpu_scan_libsvm(const char* path, long skip, long* out_max_idx) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  size_t cap = 4u << 20;
+  char* buf = static_cast<char*>(std::malloc(cap + 1));
+  if (!buf) { std::fclose(f); return -2; }
+  long rows = 0, max_idx = -1, to_skip = skip;
+  size_t have = 0;
+  bool eof = false;
+  while (!eof || have) {
+    if (!eof) {
+      size_t got = std::fread(buf + have, 1, cap - have, f);
+      have += got;
+      eof = (std::feof(f) != 0);
+    }
+    const char* end = buf + have;
+    const char* lim = end;
+    if (!eof) {
+      while (lim > buf && lim[-1] != '\n') --lim;
+      if (lim == buf) {                  // one line longer than cap: grow
+        cap *= 2;
+        char* nb2 = static_cast<char*>(std::realloc(buf, cap + 1));
+        if (!nb2) { std::free(buf); std::fclose(f); return -2; }
+        buf = nb2;
+        continue;
+      }
+    }
+    const char* p = buf;
+    while (p < lim) {
+      const char* nl = static_cast<const char*>(
+          std::memchr(p, '\n', lim - p));
+      const char* le = nl ? nl : lim;
+      if (to_skip > 0) {
+        --to_skip;
+      } else {
+        bool content = false;
+        for (const char* q = p; q < le; ++q)
+          if (*q != '\r') { content = true; break; }
+        if (content) {
+          ++rows;
+          for (const char* c = p; c < le; ++c) {
+            if (*c == ':') {
+              const char* d = c;
+              while (d > p && d[-1] >= '0' && d[-1] <= '9') --d;
+              if (d < c) {
+                long idx = std::strtol(d, nullptr, 10);
+                if (idx > max_idx) max_idx = idx;
+              }
+            }
+          }
+        }
+      }
+      if (!nl) break;
+      p = nl + 1;
+    }
+    size_t rem = static_cast<size_t>(end - lim);
+    std::memmove(buf, lim, rem);
+    have = rem;
+    if (eof) break;
+  }
+  std::free(buf);
+  std::fclose(f);
+  *out_max_idx = max_idx;
+  return rows;
+}
+
+// Chunked LibSVM parse (two-round round 1/2): COMBINED dense
+// [rows, 1 + cols] doubles with the label in column 0, so the caller's
+// delimited-chunk machinery (label_idx = 0) applies unchanged.  Framing
+// mirrors ltpu_parse_delimited_chunk: reads at most `max_bytes` from
+// `offset`, parses the complete rows, reports where the next chunk
+// starts; `skip` header lines consumed only at offset 0.
+long ltpu_parse_libsvm_chunk(const char* path, long long offset, long skip,
+                             long max_bytes, long cols, double** out_data,
+                             long long* out_next) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  if (std::fseek(f, static_cast<long>(offset), SEEK_SET) != 0) {
+    std::fclose(f);
+    return -1;
+  }
+  char* buf = static_cast<char*>(
+      std::malloc(static_cast<size_t>(max_bytes) + 1));
+  if (!buf) { std::fclose(f); return -2; }
+  size_t got = std::fread(buf, 1, static_cast<size_t>(max_bytes), f);
+  bool at_eof = (std::feof(f) != 0);
+  std::fclose(f);
+  buf[got] = '\0';
+
+  const char* end = buf + got;
+  if (!at_eof) {
+    const char* last_nl = end;
+    while (last_nl > buf && last_nl[-1] != '\n') --last_nl;
+    if (last_nl == buf) { std::free(buf); return got ? -4 : 0; }
+    end = last_nl;
+  }
+  const char* p = buf;
+  if (offset == 0) p = skip_lines(p, end, skip);
+
+  const long width = cols + 1;
+  std::vector<double> data;
+  data.reserve(1 << 16);
+  long rows = 0;
+  while (p < end) {
+    if (*p == '\n' || *p == '\r') { ++p; continue; }
+    size_t base = data.size();
+    data.resize(base + static_cast<size_t>(width), 0.0);
+    // skip leading blanks WITHIN the line only: a whitespace-only line
+    // is a (label 0, no features) row — strtod would skip across the
+    // newline and swallow the next line's label, desyncing the row
+    // count from the scan's
+    while (p < end && (*p == ' ' || *p == '\t')) ++p;
+    if (p >= end || *p == '\n' || *p == '\r') { ++rows; continue; }
+    char* next = nullptr;
+    data[base] = std::strtod(p, &next);     // complete lines: strtod
+    p = next;                               // stops at '\n' at worst
+    while (p < end && *p != '\n') {
+      while (p < end && *p == ' ') ++p;
+      if (p >= end || *p == '\n' || *p == '\r') break;
+      char* q = nullptr;
+      long idx = std::strtol(p, &q, 10);
+      if (q && q < end && *q == ':') {
+        double v = std::strtod(q + 1, &next);
+        if (idx >= 0 && idx < cols) data[base + 1 + idx] = v;
+        p = next;
+      } else {
+        while (p < end && *p != ' ' && *p != '\n' && *p != '\r') ++p;
+      }
+    }
+    ++rows;
+  }
+  *out_next = offset + (p - buf);
+  std::free(buf);
+  if (rows == 0) return 0;
+  double* out = static_cast<double*>(
+      std::malloc(data.size() * sizeof(double)));
+  if (!out) return -2;
+  std::memcpy(out, data.data(), data.size() * sizeof(double));
+  *out_data = out;
+  return rows;
+}
+
 void ltpu_free(double* p) { std::free(p); }
 
 }  // extern "C"

@@ -41,7 +41,19 @@ Memory layout notes:
   (``mode="hilo"``, C=5) giving ~f32 accuracy at 5/3 the MACs; counts are
   exact either way (MXU accumulates in f32).  This mirrors the
   reference's GPU single-precision trade-off
-  (`docs/GPU-Performance.rst:135-161`).
+  (`docs/GPU-Performance.rst:135-161`).  The default is the QUANTIZED
+  path (``mode="int8h"``, :func:`pack_values_q`): int8 operands on the
+  MXU's 2.1x-throughput integer path with EXACT int32 accumulation.
+
+On 4-bit bin packing (the reference's ``dense_nbits_bin.hpp`` /
+Feature4 DWORD lever, twice proposed as the HBM lever): measured
+against, deliberately not built.  Device traces of the fused kernel
+(r4) show the wave cost is MXU/VPU-bound at every bench shape — the
+bins stream is ~28 MB of a ~550 MB/wave total at 1M rows, under 10% of
+wave wall-clock even before the one-hot build's VPU cost; halving it at
+``max_bin<=15`` caps out at a few percent on a config the benchmarks
+don't use.  The lever that actually paid on this hardware is the int8
+MXU path above (34->42M row-iters/s measured at bench shapes).
 """
 from __future__ import annotations
 
@@ -135,10 +147,14 @@ def bin_stride(max_bins: int) -> int:
 def _col_layout(A: int, mode: str) -> tuple[int, int, int]:
     """-> (C, A_pad, cols): value columns, padded active slots, lane-
     aligned total output columns."""
-    C = {"hilo": 5, "ghilo": 4, "hhilo": 4}.get(mode, 3)
+    C = {"hilo": 5, "ghilo": 4, "hhilo": 4, "int8h": 4}.get(mode, 3)
     A_pad = _round_up(A, 8)
     cols = _round_up(C * A_pad, LANE)
     return C, A_pad, cols
+
+
+def is_quantized(mode: str) -> bool:
+    return mode in ("int8", "int8h")
 
 
 def pallas_config_ok(max_bins: int, num_leaves: int, mode: str) -> bool:
@@ -231,18 +247,118 @@ def pack_values(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
     return jnp.stack(rows, axis=0)
 
 
-def _onehot_bins(bins_i32: jnp.ndarray, B: int) -> jnp.ndarray:
-    """``[Ft, T] i32 -> [Ft*B, T] bf16`` joint (feature, bin) one-hot.
+def pack_values_q(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
+                  row_tile: int = DEFAULT_ROW_TILE,
+                  key: jnp.ndarray | None = None):
+    """Quantized value rows for the int8 MXU path: ``-> (vals int8
+    [C, n_pad], scales f32 [2])``.
 
-    Built by per-feature broadcast-compares against a bin iota — no
-    matmul, no f32 intermediate: the only materialized array is the bf16
-    one-hot itself (the previous spread-matmul formulation wrote an extra
-    ``[Ft*B, T]`` f32 and re-read it, tripling the build's VMEM traffic)."""
+    The TPU answer to the reference 4.x quantized-training idea
+    (gradient discretization): the MXU's int8 path runs 2.1x the bf16
+    throughput on this hardware (370 vs 178 Tops/s measured), and the
+    one-hot operand is 0/1 so every histogram cell accumulates EXACTLY
+    in int32 (<= n*127 < 2^31 for n <= 16M rows — no float rounding at
+    all; the only error is the per-row quantization).
+
+    mode="int8": C=3 ``(g_q, h_q, 1)``, g/h at 127/max|.| scales.
+    mode="int8h": C=4 ``(g_q, h_hi, h_lo, 1)`` — the hessian rides as a
+    two-level int8 pair (hi at sh/127, lo quantizes the hi residual at
+    sh/16129, ~14-bit absolute precision) because leaf values and gains
+    divide by hessian sums (see default_hist_mode's parity notes).
+
+    ``key``: optional PRNG key for stochastic rounding (unbiased sums:
+    E[q] == x, so quantization noise averages out over a leaf instead
+    of accumulating a rounding bias).
+    """
+    n = grad.shape[0]
+    n_pad = _round_up(n, row_tile)
+    pad = (0, n_pad - n)
+    g = grad.astype(jnp.float32)
+    h = hess.astype(jnp.float32)
+    sg = jnp.maximum(jnp.max(jnp.abs(g)), 1e-30)
+    sh = jnp.maximum(jnp.max(jnp.abs(h)), 1e-30)
+
+    def q(x, scale, sub):
+        t = x * (127.0 / scale)
+        if key is not None:
+            t = t + jax.random.uniform(
+                jax.random.fold_in(key, sub), t.shape, minval=-0.5,
+                maxval=0.5)
+        return jnp.clip(jnp.round(t), -127, 127)
+
+    gq = q(g, sg, 0)
+    if mode == "int8h":
+        hhi = jnp.clip(jnp.round(h * (127.0 / sh)), -127, 127)
+        resid = h - hhi * (sh / 127.0)
+        hlo = q(resid, sh / 127.0, 1)
+        rows = [gq, hhi, hlo, jnp.ones_like(gq)]
+    else:
+        rows = [gq, q(h, sh, 1), jnp.ones_like(gq)]
+    vals = jnp.stack([jnp.pad(r, pad) for r in rows], axis=0)
+    return vals.astype(jnp.int8), jnp.stack([sg, sh])
+
+
+def dequant_hist(out_i32: jnp.ndarray, scales: jnp.ndarray,
+                 mode: str) -> jnp.ndarray:
+    """``[A, F, B, C] int32 (+ scales) -> [A, F, B, 3] f32`` — undo
+    :func:`pack_values_q` after exact integer accumulation."""
+    sg, sh = scales[0], scales[1]
+    out = out_i32.astype(jnp.float32)
+    g = out[..., 0] * (sg / 127.0)
+    if mode == "int8h":
+        h = out[..., 1] * (sh / 127.0) + out[..., 2] * (sh / 16129.0)
+        cnt = out[..., 3]
+    else:
+        h = out[..., 1] * (sh / 127.0)
+        cnt = out[..., 2]
+    return jnp.stack([g, h, cnt], axis=-1)
+
+
+def _onehot_bins(bins_i32: jnp.ndarray, B: int,
+                 dtype=jnp.bfloat16) -> jnp.ndarray:
+    """``[Ft, T] i32 -> [Ft*B, T]`` joint (feature, bin) one-hot
+    (bf16, or int8 on the quantized path).
+
+    ONE rank-3 broadcast-compare ``[Ft, 1, T] == [1, B, T]`` reshaped to
+    ``[Ft*B, T]`` (leading-dim merge, layout-free) — no matmul, no f32
+    intermediate, and no per-feature concatenate: the concat of Ft
+    ``[B, T]`` slices re-copied the whole one-hot (~3.6 GB/wave of extra
+    VMEM traffic at 1M rows), which set the measured ~2.6 ms/wave floor
+    that dominated small waves."""
     Ft, T = bins_i32.shape
-    iota_b = jax.lax.broadcasted_iota(jnp.int32, (B, T), 0)
-    rows = [(bins_i32[f:f + 1, :] == iota_b).astype(jnp.bfloat16)
-            for f in range(Ft)]
-    return jnp.concatenate(rows, axis=0)
+    iota_b = jax.lax.broadcasted_iota(jnp.int32, (1, B, T), 1)
+    oh = bins_i32[:, None, :] == iota_b
+    if dtype == jnp.int8:
+        # via i32: direct i1->i8 hits Mosaic's unsupported
+        # (8,128)->(32,128) relayout
+        return oh.astype(jnp.int32).reshape(Ft * B, T).astype(jnp.int8)
+    return oh.astype(dtype).reshape(Ft * B, T)
+
+
+def _weighted_cols(m_bool: jnp.ndarray, vals: jnp.ndarray, n_cols: int,
+                   pad_cols: int, dtype) -> jnp.ndarray:
+    """``(m_bool [A_pad, T], vals [C, T]) -> vw [cols, T]`` in ``dtype``
+    (bf16, or int8 on the quantized path), rows ordered ``c * A_pad + a``
+    (c-major, matching the caller's output unpack).  One rank-3
+    broadcast + leading-dim merge — a per-column concat would re-copy
+    the whole block.  int8 uses a select, not a multiply (Mosaic has no
+    vector<i8> muli legalization)."""
+    A_pad, T = m_bool.shape
+    if dtype == jnp.int8:
+        # build in i32, narrow once: Mosaic has no vector<i8> muli and
+        # i1->i8 relayout ((8,128) -> (32,128) tiling) is unsupported,
+        # but i32 compute + one trunc to i8 legalizes cleanly
+        mi = m_bool.astype(jnp.int32)
+        vw = (vals.astype(jnp.int32)[:n_cols, None, :]
+              * mi[None, :, :]).reshape(n_cols * A_pad, T).astype(jnp.int8)
+    else:
+        vw = (vals[:n_cols, None, :].astype(dtype)
+              * m_bool.astype(dtype)[None, :, :]).reshape(
+                  n_cols * A_pad, T)
+    if pad_cols:
+        vw = jnp.concatenate(
+            [vw, jnp.zeros((pad_cols, T), dtype)], axis=0)
+    return vw
 
 
 def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref,
@@ -260,20 +376,19 @@ def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref,
     def _():
         out_ref[:] = jnp.zeros_like(out_ref)
 
+    quant = vals_ref.dtype == jnp.int8
+    cdt = jnp.int8 if quant else jnp.bfloat16
     # [Ft*B, T] joint (feature, bin) one-hot
-    oh = _onehot_bins(bins_ref[:].astype(jnp.int32), B)
+    oh = _onehot_bins(bins_ref[:].astype(jnp.int32), B, cdt)
 
     # [A_pad, T] leaf membership mask over the active-leaf list
-    m = (active_ref[:] == leaf_ref[:]).astype(jnp.bfloat16)
-    vals = vals_ref[:]                                       # [C, T] f32
-    blocks = [m * vals[c:c + 1, :].astype(jnp.bfloat16) for c in range(n_cols)]
-    if pad_cols:
-        blocks.append(jnp.zeros((pad_cols, m.shape[1]), jnp.bfloat16))
-    vw = jnp.concatenate(blocks, axis=0)                     # [cols, T]
+    m = active_ref[:] == leaf_ref[:]
+    vals = vals_ref[:]                                 # [C, T] f32/int8
+    vw = _weighted_cols(m, vals, n_cols, pad_cols, cdt)      # [cols, T]
 
     out_ref[:] += jax.lax.dot_general(
         oh, vw, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.int32 if quant else jnp.float32)
 
 
 @functools.partial(
@@ -284,6 +399,7 @@ def hist_active_pallas(bins_t: jnp.ndarray,
                        vals: jnp.ndarray,
                        row_leaf: jnp.ndarray,
                        active: jnp.ndarray,
+                       scales: jnp.ndarray | None = None,
                        *,
                        num_features: int,
                        max_bins: int,
@@ -360,14 +476,26 @@ def hist_active_pallas(bins_t: jnp.ndarray,
         out_specs=pl.BlockSpec((feat_tile * B, cols),
                                lambda f, r: (f, 0),
                                memory_space=pltpu.VMEM),
-        out_shape=jax.ShapeDtypeStruct((F_grid * B, cols), jnp.float32),
+        out_shape=jax.ShapeDtypeStruct(
+            (F_grid * B, cols),
+            jnp.int32 if is_quantized(mode) else jnp.float32),
         interpret=interpret,
     )(act, bins_t, vals, leaf)
 
     # [F_grid*B, cols] -> [A, F, B, C'] -> combine hi/lo -> [A, F, B, 3]
+    return _unpack_hist(out, B, cols, C, A_pad, A, num_features, mode,
+                        scales)
+
+
+def _unpack_hist(out, B, cols, C, A_pad, A, num_features, mode, scales):
+    """``[F_grid*B, cols] -> [A, F, B, 3] f32``: undo the kernel's
+    c-major column layout and combine hi/lo (or dequantize) columns."""
+    F_grid = out.shape[0] // B
     out = out.reshape(F_grid, B, cols)[:, :, :C * A_pad]
     out = out.reshape(F_grid, B, C, A_pad)
     out = out.transpose(3, 0, 1, 2)[:A, :num_features]       # [A, F, B, C]
+    if is_quantized(mode):
+        return dequant_hist(out, scales, mode)
     if C == 5:
         g = out[..., 0] + out[..., 1]
         h = out[..., 2] + out[..., 3]
@@ -506,17 +634,15 @@ def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
     # ---- histogram with the routed in-bag leaves ----------------------
     # rows-on-lanes throughout: mask [A_pad, T] straight off the routed
     # leaf row (no [1,T]->[T,1] relayout), vw [cols, T], lane contraction
-    oh = _onehot_bins(bins_ref[:].astype(jnp.int32), B)
-    m = (active_ref[:] == hl).astype(jnp.bfloat16)            # [A_pad, T]
+    quant = vals_ref.dtype == jnp.int8
+    cdt = jnp.int8 if quant else jnp.bfloat16
+    oh = _onehot_bins(bins_ref[:].astype(jnp.int32), B, cdt)
+    m = active_ref[:] == hl                                   # [A_pad, T]
     vals = vals_ref[:]                                        # [C, T]
-    blocks = [m * vals[ci:ci + 1, :].astype(jnp.bfloat16)
-              for ci in range(n_cols)]
-    if pad_cols:
-        blocks.append(jnp.zeros((pad_cols, T), jnp.bfloat16))
-    vw = jnp.concatenate(blocks, axis=0)                      # [cols, T]
+    vw = _weighted_cols(m, vals, n_cols, pad_cols, cdt)       # [cols, T]
     out_ref[:] += jax.lax.dot_general(
         oh, vw, (((1,), (1,)), ((), ())),
-        preferred_element_type=jnp.float32)
+        preferred_element_type=jnp.int32 if quant else jnp.float32)
 
 
 def fused_config_ok(num_groups: int, max_bins: int, num_leaves: int,
@@ -541,6 +667,7 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
                       feature, threshold, default_left, is_categorical,
                       cat_mask, sel, new_id, missing_types, nan_bins,
                       default_bins, feat_group, feat_offset, num_bins_arr,
+                      scales=None,
                       *, num_features: int, max_bins: int,
                       mode: str = "hilo", row_tile: int = DEFAULT_ROW_TILE,
                       interpret: bool = False, any_cat: bool = True):
@@ -607,23 +734,14 @@ def hist_route_pallas(bins_t, vals, leaf2, active,
                          memory_space=pltpu.VMEM),
         ),
         out_shape=(
-            jax.ShapeDtypeStruct((F_pad * B, cols), jnp.float32),
+            jax.ShapeDtypeStruct(
+                (F_pad * B, cols),
+                jnp.int32 if is_quantized(mode) else jnp.float32),
             jax.ShapeDtypeStruct((2, n_pad), jnp.int32),
         ),
         interpret=interpret,
     )(act, bins_t, vals, leaf2, rtabs, cat)
 
-    out = out.reshape(F_pad, B, cols)[:, :, :C * A_pad]
-    out = out.reshape(F_pad, B, C, A_pad)
-    out = out.transpose(3, 0, 1, 2)[:A, :num_features]
-    if C == 5:
-        g = out[..., 0] + out[..., 1]
-        h = out[..., 2] + out[..., 3]
-        out = jnp.stack([g, h, out[..., 4]], axis=-1)
-    elif C == 4 and mode == "hhilo":
-        h = out[..., 1] + out[..., 2]
-        out = jnp.stack([out[..., 0], h, out[..., 3]], axis=-1)
-    elif C == 4:
-        g = out[..., 0] + out[..., 1]
-        out = jnp.stack([g, out[..., 2], out[..., 3]], axis=-1)
+    out = _unpack_hist(out, B, cols, C, A_pad, A, num_features, mode,
+                       scales)
     return out, leaf2_new

@@ -13,7 +13,7 @@ import pytest
 
 from lightgbm_tpu.ops.pallas_histogram import (
     bin_stride, hist_active_pallas, hist_active_scatter, pack_values,
-    transpose_bins)
+    pack_values_q, transpose_bins)
 
 
 @pytest.mark.parametrize("max_bins,F,mode", [
@@ -51,6 +51,43 @@ def test_kernel_matches_scatter(max_bins, F, mode):
     scale = np.abs(s[..., :2]).max() + 1e-9
     np.testing.assert_allclose(p[..., :2] / scale, s[..., :2] / scale,
                                atol=tol)
+
+
+@pytest.mark.parametrize("mode", ["int8", "int8h"])
+def test_kernel_int8_matches_scatter(mode):
+    """Quantized (int8 MXU) path vs the exact scatter oracle: counts are
+    exact (int32 accumulation of a 0/1 one-hot); grad/hess sums agree to
+    quantization tolerance — per-row step is max|x|/127, so a leaf-bin
+    cell of m rows is within ~m * step / 2 of exact."""
+    rng = np.random.RandomState(7)
+    n, F, L, A, max_bins = 3000, 6, 31, 15, 63
+    bins = rng.randint(0, max_bins, size=(n, F)).astype(np.uint8)
+    grad = rng.normal(size=n).astype(np.float32)
+    hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
+    row_leaf = rng.randint(-1, L, size=n).astype(np.int32)
+    active = np.full(A, -1, np.int32)
+    active[:10] = rng.choice(L, 10, replace=False)
+
+    vals, scales = pack_values_q(jnp.asarray(grad), jnp.asarray(hess), mode)
+    assert vals.dtype == jnp.int8
+    out_p = hist_active_pallas(
+        transpose_bins(jnp.asarray(bins)), vals,
+        jnp.asarray(row_leaf), jnp.asarray(active), scales,
+        num_features=F, max_bins=max_bins, mode=mode, interpret=True)
+    out_s = hist_active_scatter(
+        jnp.asarray(bins), jnp.asarray(grad), jnp.asarray(hess),
+        jnp.asarray(row_leaf), jnp.asarray(active),
+        max_bins=max_bins, num_leaf_slots=L)
+    p, s = np.asarray(out_p)[:10], np.asarray(out_s)[:10]
+    np.testing.assert_array_equal(p[..., 2], s[..., 2])   # counts exact
+    # per-cell quantization bound: m rows, half-step each
+    step_g = float(np.abs(grad).max()) / 127.0
+    step_h = float(np.abs(hess).max()) / 127.0
+    if mode == "int8h":
+        step_h /= 127.0
+    m = s[..., 2]
+    assert np.all(np.abs(p[..., 0] - s[..., 0]) <= (m + 1) * step_g / 2)
+    assert np.all(np.abs(p[..., 1] - s[..., 1]) <= (m + 1) * step_h / 2)
 
 
 def test_hilo_split_survives_jit():

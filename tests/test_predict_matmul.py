@@ -53,6 +53,69 @@ def test_matmul_matches_walk(leaves, iters):
     np.testing.assert_allclose(mm2, mm, atol=1e-5)
 
 
+def test_matmul_categorical_matches_walk():
+    """Categorical splits through the matmul predictor (vectorized
+    bitset lookup) must match the walk — the crash-prone model class
+    (255-leaf 500-tree categorical) was one cat feature away from the
+    gather walk until r4 (VERDICT r3 #5)."""
+    rng = np.random.RandomState(5)
+    n = 4000
+    Xnum = rng.normal(size=(n, 4)).astype(np.float32)
+    Xcat = rng.randint(0, 30, size=(n, 2)).astype(np.float32)
+    X = np.concatenate([Xnum, Xcat], axis=1)
+    y = ((X[:, 0] > 0) ^ (Xcat[:, 0] % 3 == 1)).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 63},
+                     categorical_feature=[4, 5])
+    bst = lgb.train({"objective": "binary", "num_leaves": 31,
+                     "num_iterations": 12, "verbose": -1, "max_bin": 63,
+                     "categorical_feature": [4, 5]}, ds)
+    g = bst._gbdt
+    assert any(t.num_cat > 0 for t in g.models)   # cat splits happened
+    Xq = np.concatenate(
+        [rng.normal(size=(1500, 4)).astype(np.float32),
+         rng.randint(0, 35, size=(1500, 2)).astype(np.float32)], axis=1)
+    valid = g.train_set.create_valid(Xq, prediction_mode=True)
+    dd = to_device(valid)
+    sub = stack_trees(g.models, max_bins=dd.max_bins + 2)
+    walk = np.asarray(predict_binned(
+        sub, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types))
+    P, plen = build_path_matrices(g.models)
+    mm = np.asarray(predict_binned_matmul(
+        sub, jnp.asarray(P), jnp.asarray(plen), dd.bins, dd.nan_bins,
+        dd.default_bins, dd.missing_types, tchunk=5, rchunk=777))
+    np.testing.assert_allclose(mm, walk, atol=1e-4)
+    # the booster-level path now routes categorical models through the
+    # matmul predictor and must agree with itself end-to-end
+    np.testing.assert_allclose(bst.predict(Xq, raw_score=True), walk,
+                               atol=1e-4)
+
+
+def test_matmul_wide_bins_matches_walk():
+    """>256-bin models (int32 bins) go through the matmul predictor's
+    f32 select path — bin ids past 256 are not bf16-representable, so
+    this pins exactness at 1000 bins (VERDICT r3 #5)."""
+    rng = np.random.RandomState(6)
+    n = 4000
+    X = rng.normal(size=(n, 5)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * X[:, 1] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 1000})
+    bst = lgb.train({"objective": "binary", "num_leaves": 63,
+                     "num_iterations": 10, "verbose": -1,
+                     "max_bin": 1000}, ds)
+    g = bst._gbdt
+    valid = g.train_set.create_valid(X[:2000], prediction_mode=True)
+    dd = to_device(valid)
+    assert int(dd.max_bins) > 256
+    sub = stack_trees(g.models, max_bins=dd.max_bins + 2)
+    walk = np.asarray(predict_binned(
+        sub, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types))
+    P, plen = build_path_matrices(g.models)
+    mm = np.asarray(predict_binned_matmul(
+        sub, jnp.asarray(P), jnp.asarray(plen), dd.bins, dd.nan_bins,
+        dd.default_bins, dd.missing_types))
+    np.testing.assert_allclose(mm, walk, atol=1e-4)
+
+
 def test_matmul_stump_trees():
     """Stump (single-leaf) trees and tree padding contribute exactly 0."""
     rng = np.random.RandomState(2)

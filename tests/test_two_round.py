@@ -124,6 +124,119 @@ def test_two_round_peak_memory_below_raw(tmp_path):
     assert peak < 0.75 * raw_bytes, (peak, raw_bytes)
 
 
+def _write_libsvm(path, n, F, seed=0):
+    rng = np.random.RandomState(seed)
+    X = np.zeros((n, F))
+    y = rng.randint(0, 2, n)
+    lines = []
+    for i in range(n):
+        nz = rng.choice(F, rng.randint(1, max(2, F // 2)), replace=False)
+        toks = [str(int(y[i]))]
+        for j in sorted(nz):
+            v = round(float(rng.normal()), 6)
+            X[i, j] = v
+            toks.append(f"{j}:{v}")
+        lines.append(" ".join(toks))
+    with open(path, "w") as f:
+        f.write("\n".join(lines) + "\n")
+    return X, y.astype(np.float32)
+
+
+def test_two_round_libsvm_equals_one_round(tmp_path):
+    """VERDICT r3 #9: the two-round path covers LibSVM with the same
+    byte-identical-mappers contract as CSV/TSV."""
+    path = tmp_path / "t.libsvm"
+    _write_libsvm(path, 6000, 10, seed=7)
+    one = load_file(str(path), Config.from_params({"max_bin": 63}))
+    two = load_file(str(path), Config.from_params(
+        {"max_bin": 63, "use_two_round_loading": True}))
+    assert two.num_data == one.num_data == 6000
+    np.testing.assert_array_equal(one.bins, two.bins)
+    np.testing.assert_array_equal(one.feature_info.num_bins,
+                                  two.feature_info.num_bins)
+    np.testing.assert_allclose(one.metadata.label, two.metadata.label)
+
+
+def test_two_round_distributed_matches_in_memory(tmp_path):
+    """VERDICT r3 #9: use_two_round_loading composes with mod-rank
+    sharded distributed loading — every rank's binned shard matches the
+    in-memory distributed path exactly (same per-rank sample draw, same
+    feature-sharded mapper allgather)."""
+    import threading
+    from tests.test_distributed_ingest import ThreadedAllgather
+    rng = np.random.RandomState(11)
+    n, F = 3000, 6
+    X = rng.normal(size=(n, F))
+    y = (X[:, 0] > 0).astype(np.float32)
+    path = tmp_path / "d.csv"
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+
+    world = 4
+
+    def run(two_round):
+        cfg_params = {"max_bin": 63}
+        if two_round:
+            cfg_params["use_two_round_loading"] = True
+        comm = ThreadedAllgather(world)
+        out = [None] * world
+
+        def worker(r):
+            out[r] = load_file(str(path), Config.from_params(cfg_params),
+                               rank=r, num_machines=world,
+                               allgather=comm.for_rank(r))
+        ts = [threading.Thread(target=worker, args=(r,))
+              for r in range(world)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        return out
+
+    mem = run(False)
+    two = run(True)
+    assert sum(ds.num_data for ds in two) == n
+    for r in range(world):
+        np.testing.assert_array_equal(mem[r].bins, two[r].bins)
+        np.testing.assert_array_equal(mem[r].feature_info.num_bins,
+                                      two[r].feature_info.num_bins)
+        np.testing.assert_allclose(mem[r].metadata.label,
+                                   two[r].metadata.label)
+
+
+def test_two_round_distributed_shards_side_files(tmp_path):
+    """Side files are global-length: a mod-rank shard must carry the
+    slice for ITS rows (review r4 — the full array silently weighted
+    rows by the wrong entries)."""
+    import threading
+    from tests.test_distributed_ingest import ThreadedAllgather
+    rng = np.random.RandomState(13)
+    n, F, world = 1000, 4, 4
+    X = rng.normal(size=(n, F))
+    y = (X[:, 0] > 0).astype(np.float32)
+    w_full = rng.uniform(0.5, 2.0, n).astype(np.float32)
+    path = tmp_path / "d.csv"
+    np.savetxt(path, np.column_stack([y, X]), delimiter=",", fmt="%.6f")
+    np.savetxt(str(path) + ".weight", w_full, fmt="%.6f")
+
+    comm = ThreadedAllgather(world)
+    out = [None] * world
+
+    def worker(r):
+        out[r] = load_file(
+            str(path),
+            Config.from_params({"max_bin": 31,
+                                "use_two_round_loading": True}),
+            rank=r, num_machines=world, allgather=comm.for_rank(r))
+    ts = [threading.Thread(target=worker, args=(r,)) for r in range(world)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join()
+    for r in range(world):
+        np.testing.assert_allclose(out[r].metadata.weight,
+                                   w_full[r::world], atol=1e-6)
+
+
 def test_two_round_trains(tmp_path):
     path = tmp_path / "train.csv"
     X, y = _write(path, 4000, 6, seed=5)
