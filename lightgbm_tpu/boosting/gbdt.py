@@ -277,6 +277,7 @@ class GBDT:
                            else jax.jit(_raw_build))
         self._block_fns: Dict[int, object] = {}
         self._block_len_uses: Dict[int, int] = {}
+        self._block_compiling: set = set()
         # how often the host checks trees for the no-more-splits stop
         # (reference checks every iteration, gbdt.cpp:435-470; through a
         # remote tunnel each check is a ~100ms round-trip)
@@ -746,6 +747,12 @@ class GBDT:
         fn = self._block_fns.get(cap)
         if fn is not None:
             return fn
+        fn = self._make_block_fn(cap)
+        self._block_fns[cap] = fn
+        return fn
+
+    def _make_block_fn(self, cap: int):
+        """Build (without caching) the jitted length-``cap`` block."""
         obj = self.objective
         growth = self.growth
         K = self.num_tree_per_iteration
@@ -804,9 +811,49 @@ class GBDT:
                 return jnp.where(active, scores, scores_in), stacked
             return jax.lax.scan(body, scores, it0 + jnp.arange(cap))
 
-        fn = jax.jit(block)
-        self._block_fns[cap] = fn
-        return fn
+        return jax.jit(block)
+
+    def _spawn_block_compile(self, L: int) -> None:
+        """AOT-compile the length-``L`` block program on a background
+        thread and install it when ready: recurring residue lengths
+        (windowed runs, warm re-trains) upgrade from a borrowed longer
+        program to the right size WITHOUT ever stalling the training
+        loop on a 10-30 s XLA compile."""
+        if L in self._block_fns or L in self._block_compiling:
+            return
+        self._block_compiling.add(L)
+        fn = self._make_block_fn(L)
+        # install into THIS config generation's cache object: a
+        # reset_config between the spawn and the install swaps the dict,
+        # so a stale-config program can only ever land in the dead one
+        fns = self._block_fns
+        # avals only — capturing live arrays would pin the superseded
+        # scores buffer (and a second device_data reference) for the
+        # whole compile
+        aval = lambda x: jax.ShapeDtypeStruct(  # noqa: E731
+            jnp.shape(x), jnp.result_type(x))
+        args = (jax.tree.map(aval, self.device_data),
+                jax.tree.map(aval, self._bins_t),
+                aval(self.scores), aval(jnp.float32(0)),
+                aval(jnp.int32(0)), aval(jnp.int32(0)))
+
+        def work():
+            try:
+                fns[L] = fn.lower(*args).compile()
+                self._block_compiling.discard(L)
+            except Exception as exc:    # noqa: BLE001
+                # keep L in _block_compiling: a deterministic compile
+                # failure must not be retried every window — borrowed
+                # programs serve this length forever
+                log_warning(f"background compile of block length {L} "
+                            f"failed; keeping the borrowed program "
+                            f"({exc})")
+
+        import threading
+        # NON-daemon: a daemon thread mid-XLA-compile at interpreter
+        # shutdown races the runtime teardown and segfaults; a normal
+        # thread just delays exit until the compile lands
+        threading.Thread(target=work, daemon=False).start()
 
     _BLOCK_CAP = 32
 
@@ -816,12 +863,13 @@ class GBDT:
         Right size is the next power of two (masked waste < 2x), but a
         fresh length costs a full XLA compile, so: reuse an exact-length
         program when one exists; otherwise borrow the smallest
-        already-compiled length >= nb on this length's FIRST request
-        (a one-off residue — e.g. 100 = 3x32 + 4 — should never compile
-        a second program just to skip 28 masked iterations); compile the
-        right size once the same length recurs (windowed runs —
-        output_freq / snapshot_freq — would otherwise pay the masked
-        waste on EVERY window, review finding r4)."""
+        already-compiled length >= nb (a one-off residue — e.g. 100 =
+        3x32 + 4 — should never compile a second program just to skip
+        28 masked iterations).  Once the same length RECURS (windowed
+        runs — output_freq / snapshot_freq — or warm re-trains, which
+        would otherwise pay the masked waste on EVERY window), the right
+        size compiles on a background thread and takes over when ready —
+        the loop itself never stalls on a compile it can mask around."""
         L = 1
         while L < nb:
             L *= 2
@@ -830,9 +878,11 @@ class GBDT:
         if L in self._block_fns:
             return L
         borrow = [l for l in self._block_fns if l >= nb]
-        if borrow and uses < 2:
-            return min(borrow)
-        return L
+        if not borrow:
+            return L                    # nothing to mask with: compile
+        if uses >= 2:
+            self._spawn_block_compile(L)
+        return min(borrow)
 
     def train_block(self, num_iters: int) -> bool:
         """Run up to ``num_iters`` iterations, batching into scan blocks
