@@ -877,7 +877,9 @@ class GBDT:
         self._block_len_uses[L] = uses
         if L in self._block_fns:
             return L
-        borrow = [l for l in self._block_fns if l >= nb]
+        # snapshot: the background compile thread inserts into this dict
+        # (iterating the live dict would raise on a concurrent insert)
+        borrow = [l for l in list(self._block_fns) if l >= nb]
         if not borrow:
             return L                    # nothing to mask with: compile
         if uses >= 2:

@@ -439,7 +439,7 @@ class Config:
         if self.growth_mode not in ("wave", "leafwise"):
             raise ValueError(f"unknown growth_mode {self.growth_mode!r}")
         if self.hist_mode not in ("", "bf16", "ghilo", "hhilo", "hilo",
-                                  "int8", "int8h"):
+                                  "int8", "int8h", "int8hh"):
             raise ValueError(f"unknown hist_mode {self.hist_mode!r}")
         # gpu_use_dp is the reference's GPU double-precision knob
         # (docs/GPU-Performance.rst): honor it as "use the high-precision

@@ -147,14 +147,15 @@ def bin_stride(max_bins: int) -> int:
 def _col_layout(A: int, mode: str) -> tuple[int, int, int]:
     """-> (C, A_pad, cols): value columns, padded active slots, lane-
     aligned total output columns."""
-    C = {"hilo": 5, "ghilo": 4, "hhilo": 4, "int8h": 4}.get(mode, 3)
+    C = {"hilo": 5, "ghilo": 4, "hhilo": 4, "int8h": 4,
+         "int8hh": 5}.get(mode, 3)
     A_pad = _round_up(A, 8)
     cols = _round_up(C * A_pad, LANE)
     return C, A_pad, cols
 
 
 def is_quantized(mode: str) -> bool:
-    return mode in ("int8", "int8h")
+    return mode in ("int8", "int8h", "int8hh")
 
 
 def pallas_config_ok(max_bins: int, num_leaves: int, mode: str) -> bool:
@@ -265,6 +266,8 @@ def pack_values_q(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
     two-level int8 pair (hi at sh/127, lo quantizes the hi residual at
     sh/16129, ~14-bit absolute precision) because leaf values and gains
     divide by hessian sums (see default_hist_mode's parity notes).
+    mode="int8hh": C=5 — hi/lo pairs for BOTH gradient and hessian
+    (~14-bit each; 5/4 the MXU work of int8h).
 
     ``key``: optional PRNG key for stochastic rounding (unbiased sums:
     E[q] == x, so quantization noise averages out over a leaf instead
@@ -286,14 +289,20 @@ def pack_values_q(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
                 maxval=0.5)
         return jnp.clip(jnp.round(t), -127, 127)
 
-    gq = q(g, sg, 0)
-    if mode == "int8h":
-        hhi = jnp.clip(jnp.round(h * (127.0 / sh)), -127, 127)
-        resid = h - hhi * (sh / 127.0)
-        hlo = q(resid, sh / 127.0, 1)
-        rows = [gq, hhi, hlo, jnp.ones_like(gq)]
+    def hilo8(x, scale, sub):
+        hi = jnp.clip(jnp.round(x * (127.0 / scale)), -127, 127)
+        lo = q(x - hi * (scale / 127.0), scale / 127.0, sub)
+        return hi, lo
+
+    if mode == "int8hh":
+        ghi, glo = hilo8(g, sg, 0)
+        hhi, hlo = hilo8(h, sh, 1)
+        rows = [ghi, glo, hhi, hlo, jnp.ones_like(ghi)]
+    elif mode == "int8h":
+        hhi, hlo = hilo8(h, sh, 1)
+        rows = [q(g, sg, 0), hhi, hlo, jnp.ones_like(hhi)]
     else:
-        rows = [gq, q(h, sh, 1), jnp.ones_like(gq)]
+        rows = [q(g, sg, 0), q(h, sh, 1), jnp.ones_like(g)]
     vals = jnp.stack([jnp.pad(r, pad) for r in rows], axis=0)
     return vals.astype(jnp.int8), jnp.stack([sg, sh])
 
@@ -304,11 +313,16 @@ def dequant_hist(out_i32: jnp.ndarray, scales: jnp.ndarray,
     :func:`pack_values_q` after exact integer accumulation."""
     sg, sh = scales[0], scales[1]
     out = out_i32.astype(jnp.float32)
-    g = out[..., 0] * (sg / 127.0)
-    if mode == "int8h":
+    if mode == "int8hh":
+        g = out[..., 0] * (sg / 127.0) + out[..., 1] * (sg / 16129.0)
+        h = out[..., 2] * (sh / 127.0) + out[..., 3] * (sh / 16129.0)
+        cnt = out[..., 4]
+    elif mode == "int8h":
+        g = out[..., 0] * (sg / 127.0)
         h = out[..., 1] * (sh / 127.0) + out[..., 2] * (sh / 16129.0)
         cnt = out[..., 3]
     else:
+        g = out[..., 0] * (sg / 127.0)
         h = out[..., 1] * (sh / 127.0)
         cnt = out[..., 2]
     return jnp.stack([g, h, cnt], axis=-1)

@@ -110,9 +110,10 @@ def main():
         return
     legs = [("bf16", N_FULL), ("hilo", N_FULL), ("ghilo", N_FULL),
             ("hhilo", N_FULL), ("int8h", N_FULL), ("int8", N_FULL),
+            ("int8hh", N_FULL),
             ("bf16", N_SMALL), ("hilo", N_SMALL), ("ghilo", N_SMALL),
             ("hhilo", N_SMALL), ("int8h", N_SMALL), ("int8", N_SMALL),
-            ("scatter", N_SMALL)]
+            ("int8hh", N_SMALL), ("scatter", N_SMALL)]
     results = []
     if os.path.exists(ARTIFACT):
         with open(ARTIFACT) as f:
