@@ -591,11 +591,14 @@ def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
     # ---- route (previous wave's pending splits) -----------------------
     leaf = leaf2_ref[0:1, :]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
-    ohL = (iota_l == leaf).astype(jnp.float32)
+    from .pallas_route import selection_dtype
+    sel_dt = selection_dtype(tab_prec)
+    ohL = (iota_l == leaf).astype(sel_dt)
     # tab_prec (pallas_route.table_precision): bf16-exact configs use the
     # single default pass; ids past 256 need HIGHEST (the cat dot's 0/1
     # operands are exact at default precision)
-    sel16 = jnp.dot(rtabs_ref[:], ohL, preferred_element_type=jnp.float32,
+    sel16 = jnp.dot(rtabs_ref[:].astype(sel_dt), ohL,
+                    preferred_element_type=jnp.float32,
                     precision=tab_prec)
     g_row = sel16[_T_GROUP:_T_GROUP + 1, :]
     thr = sel16[_T_THR:_T_THR + 1, :]
@@ -627,7 +630,7 @@ def _hist_route_kernel(active_ref, bins_ref, vals_ref, leaf2_ref, rtabs_ref,
     le_thr = jnp.where(b <= thr, one, zero)
     num_left = jnp.where(is_missing > 0.5, dl, le_thr)
     if any_cat:
-        catrow = jnp.dot(cat_ref[:], ohL,
+        catrow = jnp.dot(cat_ref[:].astype(sel_dt), ohL,
                          preferred_element_type=jnp.float32)
         iota_b = jax.lax.broadcasted_iota(
             jnp.int32, (Bcat, T), 0).astype(jnp.float32)

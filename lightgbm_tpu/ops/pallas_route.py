@@ -72,6 +72,16 @@ def table_precision(L_pad: int, num_groups: int):
     return jax.lax.Precision.HIGHEST
 
 
+def selection_dtype(tab_prec):
+    """Operand dtype for the table-selection dots: bf16-exact configs
+    also BUILD the ``[L_pad, T]`` leaf one-hot and the tables in bf16 —
+    the one-hot is ~1 GB of VMEM writes per wave at 1M rows in f32,
+    halved here (0/1 one-hots and <256 integer tables are bf16-exact)."""
+    import jax.numpy as _jnp
+    return (_jnp.bfloat16 if tab_prec == jax.lax.Precision.DEFAULT
+            else _jnp.float32)
+
+
 def _route_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *,
                   B: int, tab_prec=jax.lax.Precision.HIGHEST,
                   any_cat: bool = True):
@@ -87,12 +97,14 @@ def _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int,
     G_pad = bins_ref.shape[0]
 
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
-    ohL = (iota_l == leaf).astype(jnp.float32)                # [L_pad, T]
+    sel_dt = selection_dtype(tab_prec)
+    ohL = (iota_l == leaf).astype(sel_dt)                     # [L_pad, T]
     # tab_prec (see table_precision): bf16-exact configs use the single
-    # default pass; larger ids need HIGHEST.  The cat/ohL dots below stay
-    # at default precision — 0/1 operands are exact in bf16 and the MXU
-    # accumulates in f32.
-    sel16 = jnp.dot(tabs_ref[:], ohL,
+    # default pass — and build ohL/tables in bf16 outright (see
+    # selection_dtype); larger ids need HIGHEST.  The cat/ohL dots below
+    # stay at default precision — 0/1 operands are exact in bf16 and the
+    # MXU accumulates in f32.
+    sel16 = jnp.dot(tabs_ref[:].astype(sel_dt), ohL,
                     preferred_element_type=jnp.float32,
                     precision=tab_prec)                       # [16, T]
     g_row = sel16[_T_GROUP:_T_GROUP + 1, :]
@@ -130,7 +142,7 @@ def _route_body(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref, *, B: int,
     le_thr = jnp.where(b <= thr, one, zero)
     num_left = jnp.where(is_missing > 0.5, dl, le_thr)
     if any_cat:
-        catrow = jnp.dot(cat_ref[:], ohL,
+        catrow = jnp.dot(cat_ref[:].astype(sel_dt), ohL,
                          preferred_element_type=jnp.float32)  # [B, T]
         iota_b = jax.lax.broadcasted_iota(
             jnp.int32, (B, T), 0).astype(jnp.float32)
@@ -167,6 +179,10 @@ def _route_values_kernel(bins_ref, leaf2_ref, tabs_ref, cat_ref, out_ref,
     T = rl.shape[1]
     L_pad = tabs_ref.shape[1]
     iota_l = jax.lax.broadcasted_iota(jnp.int32, (L_pad, T), 0)
+    # stays f32: the LVL row is the f32 RESIDUAL of the hi/lo pair —
+    # not bf16-representable; a bf16 cast here would silently collapse
+    # the pair back to bf16 leaf values (the 0.006-AUC drift the hi/lo
+    # route values exist to prevent)
     ohL2 = (iota_l == rl).astype(jnp.float32)
     sel2 = jnp.dot(tabs_ref[_T_LVH:_T_LVL + 1, :], ohL2,
                    preferred_element_type=jnp.float32)        # [2, T]
