@@ -75,8 +75,23 @@ class Dataset:
             ref = None
         if isinstance(self.data, str):
             from .io.loader import load_file
-            ds = load_file(self.data, Config.from_params(self.params),
-                           reference=ref)
+            cfg = Config.from_params(self.params)
+            rank, world, ag = 0, 1, None
+            if cfg.num_machines > 1 and ref is None:
+                import jax
+                if jax.process_count() > 1:
+                    # distributed file load: mod-rank row sharding +
+                    # feature-sharded bin-find allgather — EXCEPT for
+                    # feature-parallel, which keeps the full rows on
+                    # every machine (reference feature-parallel
+                    # semantics, feature_parallel_tree_learner.cpp)
+                    if cfg.tree_learner != "feature":
+                        from .io.distributed import jax_process_allgather
+                        rank = jax.process_index()
+                        world = jax.process_count()
+                        ag = jax_process_allgather
+            ds = load_file(self.data, cfg, reference=ref,
+                           rank=rank, num_machines=world, allgather=ag)
             if self.label is None and ds.metadata.label is not None:
                 pass
             self._constructed = ds

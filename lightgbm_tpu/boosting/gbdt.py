@@ -152,17 +152,28 @@ class GBDT:
         # program over a jax Mesh, rows padded & masked out-of-bag)
         self.mesh_ctx = None
         self._row_pad = 0
+        self._pr = None      # ProcessRows: multi-process row-block layout
         if c.tree_learner != "serial":
-            from ..parallel.mesh import MeshContext
+            from ..parallel.mesh import MeshContext, ProcessRows
             if len(jax.devices()) > 1 or c.mesh_shape:
                 self.mesh_ctx = MeshContext(c)
                 if c.tree_learner in ("data", "voting"):
-                    n_pad = self.mesh_ctx.pad_rows(n)
-                    self._row_pad = n_pad - n
+                    if jax.process_count() > 1:
+                        # cross-process training: this process's local
+                        # rows become one padded block of the global
+                        # row-sharded arrays (reference mod-rank
+                        # sharding, dataset_loader.cpp:639-742)
+                        self._pr = ProcessRows(self.mesh_ctx, n)
+                        n = self.num_data = self._pr.n_pad
+                    else:
+                        n_pad = self.mesh_ctx.pad_rows(n)
+                        self._row_pad = n_pad - n
             else:
                 log_warning(f"tree_learner={c.tree_learner} requested but "
                             f"only one device is visible; running serial")
-        if self._row_pad:
+        if self._pr is not None:
+            self.device_data = self._to_device_multiproc(train_set)
+        elif self._row_pad:
             padded = BinnedDataset.__new__(BinnedDataset)
             padded.__dict__.update(train_set.__dict__)
             padded.bins = np.concatenate(
@@ -177,13 +188,22 @@ class GBDT:
         if self.objective is None and c.objective != "none":
             self.objective = create_objective(c)
         if self.objective is not None:
-            self.objective.init(train_set.metadata, n)
+            self.objective.init(train_set.metadata, train_set.num_data)
             self.num_tree_per_iteration = self.objective.num_model_per_iteration
+            if self._pr is not None:
+                # gradients compute over the GLOBAL row axis: every
+                # per-row objective array becomes row-sharded (pad rows
+                # 0), dataset-level statistics recompute globally
+                from ..io.distributed import jax_process_allgather
+                self.objective.globalize_rows(self._pr.globalize,
+                                              jax_process_allgather)
 
         K = self.num_tree_per_iteration
         # scores built host-side and device_put in one transfer: eager
         # jnp.zeros/full each compile a mini-program over the tunnel
-        scores_np = np.zeros((n, K), np.float32)
+        n_local = train_set.num_data
+        scores_np = np.zeros((n_local if self._pr is not None else n, K),
+                             np.float32)
         # init score from metadata (continued training / custom init)
         ms = train_set.metadata.init_score
         if ms is not None:
@@ -191,11 +211,34 @@ class GBDT:
                 -1, K, order="F").astype(np.float32)
         elif c.boost_from_average and self.objective is not None:
             v = self.objective.boost_from_score()
+            if self._pr is not None:
+                # the init score must be the GLOBAL weighted label mean,
+                # not this shard's (ranks would diverge otherwise)
+                from ..io.distributed import jax_process_allgather
+                y = np.asarray(self.objective._label_np, np.float64)
+                use_w = (self.objective.boost_mean_weighted
+                         and self.objective._weight_np is not None)
+                w = (np.asarray(self.objective._weight_np, np.float64)
+                     if use_w else np.ones_like(y))
+                sums = jax_process_allgather(
+                    [float((y * w).sum()), float(w.sum())])
+                gmean = (sum(s[0] for s in sums)
+                         / max(sum(s[1] for s in sums), 1e-30))
+                # re-derive through the objective's own link: binary's
+                # logit, poisson's log, ... (same formula, global mean)
+                saved = (self.objective._label_np, self.objective._weight_np)
+                self.objective._label_np = np.array([gmean], np.float64)
+                self.objective._weight_np = None
+                v = self.objective.boost_from_score()
+                self.objective._label_np, self.objective._weight_np = saved
             if v != 0.0:
                 self.init_score_value = v
-                scores_np = np.full((n, K), v, np.float32)
+                scores_np = np.full_like(scores_np, v)
                 log_info(f"boost from average: init score = {v:.6f}")
-        self.scores = jax.device_put(scores_np)
+        if self._pr is not None:
+            self.scores = self._pr.globalize(scores_np)
+        else:
+            self.scores = jax.device_put(scores_np)
 
         self.growth = growth_params_from_config(c)
         self._label = train_set.metadata.label
@@ -204,6 +247,26 @@ class GBDT:
         self._setup_metrics()
 
         self._setup_build_program()
+
+    def _to_device_multiproc(self, train_set: BinnedDataset) -> DeviceData:
+        """Cross-process DeviceData: the bins rows are a global
+        row-sharded array assembled from every process's local block;
+        per-feature metadata is identical everywhere -> replicated.
+        (feature_meta_np keeps this from uploading a throwaway local
+        copy of the bins matrix.)"""
+        from ..io.device import feature_meta_np
+        pr = self._pr
+        meta = feature_meta_np(train_set)
+        rep = {k: pr.replicate(meta[k]) for k in (
+            "bin_offsets", "num_bins", "default_bins", "missing_types",
+            "is_categorical", "nan_bins", "feat_group", "feat_offset")}
+        return DeviceData(
+            bins=pr.globalize(train_set.bins),
+            total_bins=meta["total_bins"], max_bins=meta["max_bins"],
+            has_categorical=meta["has_categorical"],
+            max_group_bins=meta["max_group_bins"],
+            is_bundled=meta["is_bundled"],
+            has_missing=meta["has_missing"], **rep)
 
     def _setup_build_program(self) -> None:
         """(Re)build the jitted tree-build closure from the CURRENT config
@@ -483,6 +546,22 @@ class GBDT:
         """Run the jitted tree build (serial or distributed)."""
         if self.mesh_ctx is not None:
             n = self.num_data
+            if self._pr is not None:
+                # cross-process: the bagging mask is a pure function of
+                # (seed, iteration) so every rank computes the identical
+                # full [n_pad] mask; each contributes its block, with
+                # its per-block padding rows masked out-of-bag
+                pr = self._pr
+                mask = pr.valid_mask_local()
+                if bag is not None:
+                    full = np.asarray(bag)
+                    r = jax.process_index()
+                    mask = mask & full[r * pr.per:(r + 1) * pr.per]
+                bag = pr.globalize(mask, fill=False)
+                if fmask is not None:
+                    fmask = pr.replicate(np.asarray(fmask))
+                return self._jit_build(self.device_data, grad, hess, bag,
+                                       fmask)
             pad = self._row_pad
             if bag is None:
                 bag = jnp.ones(n, bool)
@@ -683,6 +762,12 @@ class GBDT:
 
     # ------------------------------------------------------------------
     def eval_train(self) -> List[Tuple[str, str, float, bool]]:
+        if self._pr is not None:
+            # global scores span other processes' devices: evaluate this
+            # rank's own rows (the reference's machines likewise report
+            # their local shard's training metric)
+            return self._eval_set("training", self._pr.local_np(self.scores),
+                                  self._label, self._weight, self._query)
         return self._eval_set("training", np.asarray(self.scores),
                               self._label, self._weight, self._query)
 

@@ -61,6 +61,8 @@ def run(argv: List[str]) -> int:
     cfg = Config.from_params(params)
     set_verbosity(cfg.verbose)
     task = cfg.task
+    if cfg.num_machines > 1:
+        _init_network(cfg)
     if task == "train":
         _run_train(cfg, params)
     elif task in ("predict", "prediction", "test"):
@@ -72,6 +74,39 @@ def run(argv: List[str]) -> int:
     else:
         raise ValueError(f"unknown task {task!r}")
     return 0
+
+
+def _init_network(cfg: Config) -> None:
+    """Reference Application -> Network::Init (application.cpp:249-254 +
+    linkers_socket.cpp): every machine runs the SAME conf; the machine
+    list (machines= or machine_list_file=) names the world, the first
+    entry is the rendezvous coordinator, and each process resolves its
+    own rank by finding its local endpoint in the list."""
+    # already-meshed check WITHOUT touching the backend
+    # (jax.process_count() would initialize XLA, and
+    # jax.distributed.initialize must come first)
+    from jax._src import distributed as _dist
+    if getattr(_dist.global_state, "client", None) is not None:
+        return                              # environment already meshed
+    from .parallel.mesh import init_distributed_from_machines
+    machines = cfg.machines
+    if not machines and cfg.machine_list_file:
+        from .utils.file_io import open_read
+        with open_read(cfg.machine_list_file) as f:
+            # reference mlist.txt lines are space-separated "ip port"
+            # (examples/parallel_learning/mlist.txt); normalize to the
+            # machines= "ip:port" form
+            machines = ",".join(
+                ":".join(ln.split()) for ln in f if ln.strip())
+    if not machines:
+        raise ValueError(
+            "num_machines > 1 needs machines=ip:port,... or "
+            "machine_list_file= (reference mlist.txt semantics)")
+    init_distributed_from_machines(machines, cfg.local_listen_port,
+                                   cfg.num_machines)
+    import jax
+    log_info(f"distributed: rank {jax.process_index()} of "
+             f"{jax.process_count()} joined the mesh")
 
 
 def _run_train(cfg: Config, params) -> None:
@@ -89,8 +124,10 @@ def _run_train(cfg: Config, params) -> None:
                     init_model=cfg.input_model or None,
                     early_stopping_rounds=cfg.early_stopping_round or None,
                     verbose_eval=cfg.output_freq)
-    booster.save_model(cfg.output_model)
-    log_info(f"finished training; model saved to {cfg.output_model}")
+    import jax
+    if jax.process_index() == 0:    # every rank holds the identical model
+        booster.save_model(cfg.output_model)
+        log_info(f"finished training; model saved to {cfg.output_model}")
 
 
 def _load_predict_input(cfg: Config):

@@ -77,7 +77,11 @@ class DeviceData(NamedTuple):
         return self.max_group_bins or self.max_bins
 
 
-def to_device(ds: BinnedDataset) -> DeviceData:
+def feature_meta_np(ds: BinnedDataset) -> dict:
+    """The per-feature metadata of :func:`to_device` as HOST numpy plus
+    the static fields — shared by the single-device converter and the
+    multi-process path (which replicates these and builds the bins rows
+    as a global sharded array WITHOUT a throwaway local bins upload)."""
     info = ds.feature_info
     from .binning import MISSING_NAN
     nan_bins = np.where(info.missing_types == MISSING_NAN,
@@ -93,20 +97,36 @@ def to_device(ds: BinnedDataset) -> DeviceData:
         feat_offset = np.full(F, -1, np.int32)
         max_group_bins = int(info.max_num_bins)
         is_bundled = False
-    return DeviceData(
-        bins=jnp.asarray(ds.bins),
-        bin_offsets=jnp.asarray(info.bin_offsets[:-1], jnp.int32),
-        num_bins=jnp.asarray(info.num_bins, jnp.int32),
-        default_bins=jnp.asarray(info.default_bins, jnp.int32),
-        missing_types=jnp.asarray(info.missing_types, jnp.int32),
-        is_categorical=jnp.asarray(info.is_categorical),
-        nan_bins=jnp.asarray(nan_bins),
-        feat_group=jnp.asarray(feat_group, jnp.int32),
-        feat_offset=jnp.asarray(feat_offset, jnp.int32),
+    return dict(
+        bin_offsets=np.asarray(info.bin_offsets[:-1], np.int32),
+        num_bins=np.asarray(info.num_bins, np.int32),
+        default_bins=np.asarray(info.default_bins, np.int32),
+        missing_types=np.asarray(info.missing_types, np.int32),
+        is_categorical=np.asarray(info.is_categorical),
+        nan_bins=nan_bins,
+        feat_group=np.asarray(feat_group, np.int32),
+        feat_offset=np.asarray(feat_offset, np.int32),
         total_bins=int(info.total_bins),
         max_bins=int(info.max_num_bins),
         has_categorical=bool(info.is_categorical.any()),
         max_group_bins=max_group_bins,
         is_bundled=is_bundled,
         has_missing=bool((info.missing_types != 0).any()),
+    )
+
+
+def to_device(ds: BinnedDataset) -> DeviceData:
+    meta = feature_meta_np(ds)
+    arrays = {k: jnp.asarray(meta[k]) for k in (
+        "bin_offsets", "num_bins", "default_bins", "missing_types",
+        "is_categorical", "nan_bins", "feat_group", "feat_offset")}
+    return DeviceData(
+        bins=jnp.asarray(ds.bins),
+        total_bins=meta["total_bins"],
+        max_bins=meta["max_bins"],
+        has_categorical=meta["has_categorical"],
+        max_group_bins=meta["max_group_bins"],
+        is_bundled=meta["is_bundled"],
+        has_missing=meta["has_missing"],
+        **arrays,
     )

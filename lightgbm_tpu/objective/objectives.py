@@ -76,6 +76,23 @@ class ObjectiveFunction:
             return float((y * w).sum() / w.sum())
         return float(y.mean())
 
+    # True when boost_from_score keys on the WEIGHTED label mean
+    # (xentlambda uses the unweighted one); multi-process init uses this
+    # to pick the right global sufficient statistic
+    boost_mean_weighted = True
+
+    def globalize_rows(self, globalize, allgather) -> None:
+        """Multi-process training: re-align per-row state to the GLOBAL
+        row axis and recompute whole-dataset statistics with
+        cross-process sufficient stats.  ``globalize(np [n_local, ...])
+        -> global row-sharded array`` (pad rows 0); ``allgather(obj) ->
+        per-rank list``.  Subclasses with extra per-row arrays or
+        dataset-level scalars MUST override (and call super)."""
+        self.label = globalize(np.asarray(self._label_np, np.float32))
+        if self.weight is not None:
+            self.weight = globalize(np.asarray(self._weight_np,
+                                               np.float32))
+
     def _check_label(self) -> None:
         pass
 
@@ -233,6 +250,11 @@ class Mape(ObjectiveFunction):
         lw = 1.0 / jnp.maximum(1.0, jnp.abs(self.label))
         self.label_weight = lw if self.weight is None else lw * self.weight
 
+    def globalize_rows(self, globalize, allgather):
+        lw = np.asarray(self.label_weight, np.float32)
+        super().globalize_rows(globalize, allgather)
+        self.label_weight = globalize(lw)       # per-row state realigns
+
     def get_gradients(self, score):
         diff = score - self.label
         grad = jnp.sign(diff) * self.label_weight
@@ -302,6 +324,20 @@ class BinaryLogloss(ObjectiveFunction):
         else:
             self.label_weights = (1.0, self.scale_pos_weight)
         self._cnt_pos, self._cnt_neg = cnt_pos, cnt_neg
+
+    def globalize_rows(self, globalize, allgather):
+        super().globalize_rows(globalize, allgather)
+        if self.is_unbalance:
+            # class counts are a GLOBAL statistic: per-shard counts
+            # would bake different scalars into the same SPMD program
+            counts = allgather([self._cnt_pos, self._cnt_neg])
+            cnt_pos = sum(c[0] for c in counts)
+            cnt_neg = sum(c[1] for c in counts)
+            self._cnt_pos, self._cnt_neg = cnt_pos, cnt_neg
+            if cnt_pos > 0 and cnt_neg > 0:
+                self.label_weights = ((1.0, cnt_pos / cnt_neg)
+                                      if cnt_pos > cnt_neg
+                                      else (cnt_neg / cnt_pos, 1.0))
 
     def get_gradients(self, score):
         y = self.label
@@ -411,6 +447,7 @@ class CrossEntropy(ObjectiveFunction):
 
 class CrossEntropyLambda(ObjectiveFunction):
     name = "xentlambda"
+    boost_mean_weighted = False   # boost_from_score uses the plain mean
 
     def get_gradients(self, score):
         # intensity parameterization: p = 1 - exp(-w*exp(score))
@@ -449,6 +486,14 @@ class LambdarankNDCG(ObjectiveFunction):
         if not gains:
             gains = tuple(float((1 << i) - 1) for i in range(31))
         self.label_gain = np.asarray(gains, np.float64)
+
+    def globalize_rows(self, globalize, allgather):
+        raise NotImplementedError(
+            "lambdarank is not supported with mod-rank multi-process "
+            "training: its per-query index structures address local "
+            "rows.  Use is_pre_partition=true with per-rank files that "
+            "keep queries whole (the loader enforces the same contract "
+            "for query data, reference dataset_loader.cpp:639-742).")
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)

@@ -101,6 +101,53 @@ def init_distributed_from_machines(machines: str, local_listen_port: int,
                      num_processes=num_machines, process_id=rank)
 
 
+class ProcessRows:
+    """Block layout of mod-rank-sharded local rows inside global
+    row-sharded arrays (multi-process data/voting-parallel training).
+
+    Each process contributes ONE padded block of the global row axis:
+    ``[rank*per, rank*per + n_local)`` are its real rows, the rest of
+    the block is padding (masked out-of-bag).  The reference's
+    equivalent is each machine's local row range after mod-rank
+    sharding (`dataset_loader.cpp:639-742`)."""
+
+    def __init__(self, mesh_ctx: "MeshContext", n_local: int):
+        from ..io.distributed import jax_process_allgather
+        self.mesh_ctx = mesh_ctx
+        self.world = jax.process_count()
+        self.counts = [int(x) for x in jax_process_allgather(int(n_local))]
+        self.n_local = int(n_local)
+        self.n_global = sum(self.counts)
+        ld = jax.local_device_count()
+        # per-process block: covers the largest local shard, divisible
+        # by the local device count so every device shard is equal
+        self.per = -(-max(self.counts) // ld) * ld
+        self.n_pad = self.per * self.world
+
+    def globalize(self, local: np.ndarray, fill=0) -> jax.Array:
+        """``[n_local, ...] -> global [n_pad, ...]`` row-sharded array."""
+        local = np.asarray(local)
+        block = np.full((self.per,) + local.shape[1:], fill, local.dtype)
+        block[:len(local)] = local
+        return jax.make_array_from_process_local_data(
+            self.mesh_ctx.row_sharding(), block)
+
+    def replicate(self, x) -> jax.Array:
+        return jax.device_put(np.asarray(x), self.mesh_ctx.replicated())
+
+    def valid_mask_local(self) -> np.ndarray:
+        m = np.zeros(self.per, bool)
+        m[:self.n_local] = True
+        return m
+
+    def local_np(self, global_arr) -> np.ndarray:
+        """This process's REAL rows of a global row-sharded array."""
+        shards = sorted(global_arr.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        block = np.concatenate([np.asarray(s.data) for s in shards])
+        return block[:self.n_local]
+
+
 class MeshContext:
     """A 1-D (data) or 2-D (data × feature) device mesh + shard helpers."""
 

@@ -1,0 +1,57 @@
+"""Worker for the CLI distributed-launcher test: one rank of a
+2-machine run driven EXACTLY the way the reference documents
+(`examples/parallel_learning/README.md`): the same train.conf on every
+machine plus a machine list; rank is resolved from the list (here by
+listen port — an all-loopback list), the first entry is the rendezvous
+coordinator, training runs the configured tree_learner over the
+cross-process mesh, and rank 0 saves the model.
+"""
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+
+
+def main():
+    port0, port1, my_port, learner, workdir = sys.argv[1:6]
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    ex = "/root/reference/examples/parallel_learning"
+    from lightgbm_tpu.cli import run
+    model = os.path.join(workdir, "model.txt")
+    rc = run([
+        f"config={ex}/train.conf",
+        f"data={ex}/binary.train",
+        f"valid_data={ex}/binary.test",
+        f"machines=127.0.0.1:{port0},127.0.0.1:{port1}",
+        f"local_listen_port={my_port}",
+        f"tree_learner={learner}",
+        "num_trees=8", "max_bin=63", "verbose=-1",
+        f"output_model={model}",
+    ])
+    assert rc == 0
+    rank = jax.process_index()
+    if rank == 0:
+        assert os.path.exists(model)
+        # quality gate on the held-out example file
+        import numpy as np
+        from lightgbm_tpu.basic import Booster
+        test = np.loadtxt(f"{ex}/binary.test")
+        yt, Xt = test[:, 0], test[:, 1:]
+        bst = Booster(model_file=model)
+        s = bst.predict(Xt, raw_score=True)
+        order = np.argsort(s, kind="stable")
+        ranks = np.empty(len(yt)); ranks[order] = np.arange(1, len(yt) + 1)
+        npos = yt.sum()
+        auc = ((ranks[yt > 0.5].sum() - npos * (npos + 1) / 2)
+               / (npos * (len(yt) - npos)))
+        assert auc > 0.7, auc
+        print(f"CLI_MULTIHOST_AUC={auc:.4f}")
+    print(f"CLI_MULTIHOST_OK rank={rank} learner={learner}")
+
+
+if __name__ == "__main__":
+    main()

@@ -48,3 +48,41 @@ def test_two_process_distributed_train():
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"MULTIHOST_OK rank={r}" in out, out
+
+
+CLI_WORKER = os.path.join(os.path.dirname(__file__),
+                          "multihost_cli_worker.py")
+
+
+@pytest.mark.parametrize("learner", ["data", "feature"])
+def test_cli_distributed_parallel_learning_example(learner, tmp_path):
+    """The reference's documented distributed workflow
+    (examples/parallel_learning/README.md): the SAME train.conf + a
+    machine list on every machine, driven through OUR CLI — rendezvous
+    from the list, sharded (data) or replicated (feature) file load,
+    cross-process mesh training, rank-0 model save."""
+    if not os.path.isdir("/root/reference/examples/parallel_learning"):
+        pytest.skip("reference examples not mounted")
+    p0, p1 = _free_port(), _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    env.pop("XLA_FLAGS", None)          # worker pins 1 device/process
+    procs = [subprocess.Popen(
+        [sys.executable, CLI_WORKER, str(p0), str(p1), str(port), learner,
+         str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for port in (p0, p1)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
+        assert f"CLI_MULTIHOST_OK rank={r}" in out, out[-2000:]
+    assert "CLI_MULTIHOST_AUC=" in outs[0]
