@@ -81,11 +81,13 @@ class Dataset:
                 import jax
                 if jax.process_count() > 1:
                     # distributed file load: mod-rank row sharding +
-                    # feature-sharded bin-find allgather — EXCEPT for
-                    # feature-parallel, which keeps the full rows on
-                    # every machine (reference feature-parallel
-                    # semantics, feature_parallel_tree_learner.cpp)
-                    if cfg.tree_learner != "feature":
+                    # feature-sharded bin-find allgather — ONLY for the
+                    # row-sharding learners.  Feature-parallel keeps the
+                    # full rows on every machine (reference semantics,
+                    # feature_parallel_tree_learner.cpp), and serial
+                    # must too (sharding it would silently train each
+                    # rank on 1/world of the data)
+                    if cfg.tree_learner in ("data", "voting"):
                         from .io.distributed import jax_process_allgather
                         rank = jax.process_index()
                         world = jax.process_count()

@@ -163,6 +163,12 @@ class GBDT:
                         # rows become one padded block of the global
                         # row-sharded arrays (reference mod-rank
                         # sharding, dataset_loader.cpp:639-742)
+                        if self.boosting_name != "gbdt":
+                            raise NotImplementedError(
+                                f"boosting={self.boosting_name} is not "
+                                "supported with multi-process training "
+                                "(its per-iteration host flow assumes "
+                                "addressable scores); use boosting=gbdt")
                         self._pr = ProcessRows(self.mesh_ctx, n)
                         n = self.num_data = self._pr.n_pad
                     else:
@@ -210,27 +216,14 @@ class GBDT:
             scores_np = np.asarray(ms, np.float64).reshape(
                 -1, K, order="F").astype(np.float32)
         elif c.boost_from_average and self.objective is not None:
-            v = self.objective.boost_from_score()
             if self._pr is not None:
-                # the init score must be the GLOBAL weighted label mean,
-                # not this shard's (ranks would diverge otherwise)
+                # the init score must come from GLOBAL statistics, not
+                # this shard's (ranks would diverge otherwise)
                 from ..io.distributed import jax_process_allgather
-                y = np.asarray(self.objective._label_np, np.float64)
-                use_w = (self.objective.boost_mean_weighted
-                         and self.objective._weight_np is not None)
-                w = (np.asarray(self.objective._weight_np, np.float64)
-                     if use_w else np.ones_like(y))
-                sums = jax_process_allgather(
-                    [float((y * w).sum()), float(w.sum())])
-                gmean = (sum(s[0] for s in sums)
-                         / max(sum(s[1] for s in sums), 1e-30))
-                # re-derive through the objective's own link: binary's
-                # logit, poisson's log, ... (same formula, global mean)
-                saved = (self.objective._label_np, self.objective._weight_np)
-                self.objective._label_np = np.array([gmean], np.float64)
-                self.objective._weight_np = None
+                v = self.objective.boost_from_score_global(
+                    jax_process_allgather)
+            else:
                 v = self.objective.boost_from_score()
-                self.objective._label_np, self.objective._weight_np = saved
             if v != 0.0:
                 self.init_score_value = v
                 scores_np = np.full_like(scores_np, v)
@@ -400,6 +393,19 @@ class GBDT:
             return None
         return _device_bag_mask(c.bagging_seed, it // c.bagging_freq,
                                 self.num_data, c.bagging_fraction)
+
+    def _block_sample(self, G, H, it):
+        """Per-iteration row sampling inside the fused block: ``(G, H,
+        it) -> (G, H, bag_mask_or_None)``.  Plain GBDT applies the
+        bagging mask; GOSS overrides with gradient-based one-side
+        sampling.  Both are pure in (seed, iteration), so the block and
+        per-iteration paths build identical trees."""
+        c = self.config
+        if c.bagging_freq > 0 and c.bagging_fraction < 1.0:
+            return G, H, _device_bag_mask(
+                c.bagging_seed, it // c.bagging_freq, self.num_data,
+                c.bagging_fraction)
+        return G, H, None
 
     def _feature_mask(self, tree_idx: int) -> Optional[jnp.ndarray]:
         """Per-tree feature subsampling (serial_tree_learner.cpp:240-266),
@@ -814,7 +820,7 @@ class GBDT:
             # large n) can push a 32-iteration block past the device's
             # dispatch watchdog; per-iteration dispatches stay short
             return False
-        return (self.boosting_name == "gbdt"
+        return (self.boosting_name in ("gbdt", "goss")
                 and self.mesh_ctx is None
                 and self.fobj is None
                 and self.objective is not None
@@ -844,7 +850,6 @@ class GBDT:
         c = self.config
         n = self.num_data
         F = self.device_data.num_features
-        bag_on = c.bagging_freq > 0 and c.bagging_fraction < 1.0
         ff_on = c.feature_fraction < 1.0
         kf = max(1, int(c.feature_fraction * F))
 
@@ -862,13 +867,11 @@ class GBDT:
                     G, H = g[:, None], h[:, None]
                 else:
                     G, H = obj.get_gradients(scores)
-                # sampling masks derived on device, pure in iteration —
-                # the same functions the per-iteration path calls, so a
-                # bagged config no longer falls off the fused fast path
-                bag = (_device_bag_mask(c.bagging_seed,
-                                        it // c.bagging_freq, n,
-                                        c.bagging_fraction)
-                       if bag_on else None)
+                # sampling derived on device, pure in iteration — the
+                # same functions the per-iteration path uses, so bagged
+                # (and GOSS: _block_sample override) configs stay on
+                # the fused fast path
+                G, H, bag = self._block_sample(G, H, it)
                 outs = []
                 for k in range(K):
                     fmask = (_device_feature_mask(c.feature_fraction_seed,
@@ -993,7 +996,8 @@ class GBDT:
         # (review r4 finding: a rolled-back real tree would leave its
         # score contribution behind).
         speculate = ((c.bagging_freq <= 0 or c.bagging_fraction >= 1.0)
-                     and c.feature_fraction >= 1.0)
+                     and c.feature_fraction >= 1.0
+                     and self.boosting_name == "gbdt")  # GOSS resamples
         prev_check = None                  # pending num_leaves slice
         stopped = False
         while done < num_iters and not stopped:

@@ -117,33 +117,38 @@ class DART(GBDT):
 class GOSS(GBDT):
     """Gradient-based One-Side Sampling (reference goss.hpp:36-214): keep
     the top `top_rate` rows by |grad·hess|, sample `other_rate` of the rest
-    and amplify their gradients by (1-a)/b."""
+    and amplify their gradients by (1-a)/b.
+
+    The sampling is a pure jnp transform of (gradients, iteration), so
+    it runs INSIDE the fused ``lax.scan`` block (`_block_sample`) —
+    GOSS configs keep the single-dispatch fast path; the per-iteration
+    override below uses the identical derivation (same
+    (seed, iteration)-keyed Bernoulli draw), so both paths build the
+    same trees."""
 
     boosting_name = "goss"
 
-    def __init__(self, config: Config, train_set, objective=None, fobj=None):
-        super().__init__(config, train_set, objective, fobj)
-        self._rng_goss = np.random.RandomState(config.bagging_seed)
-
-    def train_one_iter(self, grad=None, hess=None) -> bool:
+    def _block_sample(self, G, H, it):
+        import jax
         c = self.config
-        if grad is None or hess is None:
-            grad, hess = self._gradients()
         n = self.num_data
         a, b = c.top_rate, c.other_rate
         top_k = max(1, int(n * a))
         # importance = sum over classes of |g*h| (goss.hpp BaggingHelper)
-        imp = jnp.sum(jnp.abs(grad * hess), axis=1)
+        imp = jnp.sum(jnp.abs(G * H), axis=1)
         threshold = jnp.sort(imp)[-top_k]
         is_top = imp >= threshold
-        rnd = jnp.asarray(self._rng_goss.rand(n))
+        key = jax.random.fold_in(jax.random.PRNGKey(c.bagging_seed), it)
+        rnd = jax.random.uniform(key, (n,))
         is_other = (~is_top) & (rnd < b / max(1e-12, 1.0 - a))
         multiplier = (1.0 - a) / max(b, 1e-12)
         scale = jnp.where(is_other, multiplier, 1.0)[:, None]
-        bag = is_top | is_other
-        grad = grad * scale
-        hess = hess * scale
-        self._goss_bag = bag
+        return G * scale, H * scale, is_top | is_other
+
+    def train_one_iter(self, grad=None, hess=None) -> bool:
+        if grad is None or hess is None:
+            grad, hess = self._gradients()
+        grad, hess, bag = self._block_sample(grad, hess, self.iter)
         return self._train_with_bag(grad, hess, bag)
 
     def _train_with_bag(self, grad, hess, bag) -> bool:

@@ -93,6 +93,29 @@ class ObjectiveFunction:
             self.weight = globalize(np.asarray(self._weight_np,
                                                np.float32))
 
+    def boost_from_score_global(self, allgather) -> float:
+        """Cross-process BoostFromScore: every current objective's init
+        score is a function of the (un)weighted label mean, so allgather
+        that sufficient statistic and re-derive through the objective's
+        own link (logit/log/...) by evaluating boost_from_score on a
+        one-row stand-in.  An objective whose init score is NOT a mean
+        function (e.g. a future reference-parity weighted-median L1
+        boost) MUST override with its own global statistic."""
+        y = np.asarray(self._label_np, np.float64)
+        use_w = self.boost_mean_weighted and self._weight_np is not None
+        w = (np.asarray(self._weight_np, np.float64) if use_w
+             else np.ones_like(y))
+        sums = allgather([float((y * w).sum()), float(w.sum())])
+        gmean = (sum(s[0] for s in sums)
+                 / max(sum(s[1] for s in sums), 1e-30))
+        saved = (self._label_np, self._weight_np)
+        try:
+            self._label_np = np.array([gmean], np.float64)
+            self._weight_np = None
+            return self.boost_from_score()
+        finally:
+            self._label_np, self._weight_np = saved
+
     def _check_label(self) -> None:
         pass
 

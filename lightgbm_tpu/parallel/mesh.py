@@ -141,10 +141,14 @@ class ProcessRows:
         return m
 
     def local_np(self, global_arr) -> np.ndarray:
-        """This process's REAL rows of a global row-sharded array."""
-        shards = sorted(global_arr.addressable_shards,
-                        key=lambda s: s.index[0].start or 0)
-        block = np.concatenate([np.asarray(s.data) for s in shards])
+        """This process's REAL rows of a global row-sharded array.
+        Shards are DEDUPED by row offset: on a 2-D (data x feature)
+        mesh the feature-axis devices hold row replicas."""
+        by_start = {}
+        for s in global_arr.addressable_shards:
+            by_start.setdefault(s.index[0].start or 0, s.data)
+        block = np.concatenate(
+            [np.asarray(by_start[k]) for k in sorted(by_start)])
         return block[:self.n_local]
 
 

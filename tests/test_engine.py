@@ -271,6 +271,26 @@ def test_goss():
     assert evals["valid_0"]["auc"][-1] > 0.93
 
 
+def test_goss_stays_on_block_path():
+    """GOSS sampling is a pure jnp transform of (gradients, iteration),
+    run inside the fused scan — GOSS configs are block-eligible AND the
+    block path builds the identical model to per-iteration."""
+    X, y = _binary_data()
+    params = {"objective": "binary", "boosting": "goss", "num_leaves": 15,
+              "top_rate": 0.3, "other_rate": 0.2, "verbose": -1}
+    bst = lgb.train(params, lgb.Dataset(X, label=y), 12, verbose_eval=False)
+    assert bst._gbdt._can_block()
+    os.environ["LGBM_TPU_NO_BLOCK"] = "1"
+    try:
+        ref = lgb.train(params, lgb.Dataset(X, label=y), 12,
+                        verbose_eval=False)
+    finally:
+        del os.environ["LGBM_TPU_NO_BLOCK"]
+    np.testing.assert_allclose(bst.predict(X[:300], raw_score=True),
+                               ref.predict(X[:300], raw_score=True),
+                               atol=1e-5)
+
+
 def test_rf():
     X, y = _binary_data()
     train = lgb.Dataset(X, label=y)
