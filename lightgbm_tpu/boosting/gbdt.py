@@ -945,6 +945,37 @@ class GBDT:
 
     _BLOCK_CAP = 32
 
+    # NOTE: no RESOURCE_EXHAUSTED — a deterministic HBM OOM must fail
+    # fast, not be retried behind "transient" warnings
+    _TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED",
+                          "Connection reset", "Broken pipe",
+                          "Socket closed")
+
+    def _dispatch_retry(self, fn, *args):
+        """Run a PURE jitted dispatch with transient-failure retries
+        (the reference's socket layer retries sends the same way,
+        linkers_socket.cpp; on a tunneled TPU the transient class is
+        RPC-flavored).  Safe because the block programs are functional —
+        inputs are untouched until the result is assigned.  Covers the
+        dispatch/compile path (where tunnel RPC failures surface
+        synchronously); asynchronous execution faults still propagate
+        at the next fetch."""
+        last = None
+        for attempt in range(3):
+            try:
+                return fn(*args)
+            except Exception as exc:    # noqa: BLE001 - filtered below
+                msg = str(exc)
+                if not any(m in msg for m in self._TRANSIENT_MARKERS):
+                    raise
+                last = exc
+                if attempt < 2:       # no false "retrying" + sleep on
+                    log_warning(      # the final failure
+                        f"transient device error (attempt "
+                        f"{attempt + 1}/3), retrying: {msg[:200]}")
+                    time.sleep(1.0 + attempt)
+        raise last
+
     def _pick_block_len(self, nb: int) -> int:
         """Compiled scan length for a block of ``nb`` active iterations.
 
@@ -1010,11 +1041,10 @@ class GBDT:
             nb = min(num_iters - done, self._BLOCK_CAP)
             fn = self._block_fn(self._pick_block_len(nb))
             with tag("block") as tdone:
-                self.scores, trees = fn(self.device_data, self._bins_t,
-                                        self.scores,
-                                        jnp.float32(self.shrinkage_rate),
-                                        jnp.int32(self.iter),
-                                        jnp.int32(nb))
+                self.scores, trees = self._dispatch_retry(
+                    fn, self.device_data, self._bins_t, self.scores,
+                    jnp.float32(self.shrinkage_rate),
+                    jnp.int32(self.iter), jnp.int32(nb))
                 tdone(trees.num_leaves)
             # init-score bias rides the pending entry and is baked into
             # the first K host trees at flush (no separate per-iteration

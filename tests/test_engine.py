@@ -410,3 +410,28 @@ def test_pred_early_stop():
     assert (2.0 * np.abs(es[stopped]) > 2.0 - 1e-3).all()
     agree = np.sign(es[stopped]) == np.sign(full[stopped])
     assert agree.mean() > 0.99, agree.mean()
+
+
+def test_transient_dispatch_retry():
+    """A dispatch that fails with a transient RPC-class error is retried
+    with the same (pure) inputs; non-transient errors propagate."""
+    X, y = _binary_data(n=400)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7},
+                    lgb.Dataset(X, label=y), 2, verbose_eval=False)
+    g = bst._gbdt
+    calls = {"n": 0}
+
+    def flaky(*args):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise RuntimeError("UNAVAILABLE: tunnel hiccup")
+        return "ok"
+
+    assert g._dispatch_retry(flaky) == "ok"
+    assert calls["n"] == 2
+
+    def fatal(*args):
+        raise RuntimeError("INVALID_ARGUMENT: shape mismatch")
+
+    with pytest.raises(RuntimeError, match="INVALID_ARGUMENT"):
+        g._dispatch_retry(fatal)
