@@ -919,17 +919,14 @@ class GBDT:
                 return jnp.where(active, scores, scores_in), stacked
             return jax.lax.scan(body, scores, it0 + jnp.arange(cap))
 
-        opts = None
         from ..learner.serial import _COMPILE_LEAN_ROWS
         if n <= _COMPILE_LEAN_ROWS and _effort_opt_supported():
             # small data: XLA compile time dominates the cold start and
             # runtime barely responds to optimization effort — measured
             # 6.2 s -> 3.0 s compile with identical ms/iter at 7k rows
-            opts = {"exec_time_optimization_effort": -1.0}
-        try:
-            return jax.jit(block, compiler_options=opts)
-        except TypeError:               # older jax: no compiler_options
-            return jax.jit(block)
+            return jax.jit(block, compiler_options={
+                "exec_time_optimization_effort": -1.0})
+        return jax.jit(block)
 
     def _spawn_block_compile(self, L: int) -> None:
         """AOT-compile the length-``L`` block program on a background

@@ -1,0 +1,165 @@
+"""scikit-learn API + plotting + callbacks — the counterpart of the
+reference's `tests/python_package_test/test_sklearn.py` and
+`test_plotting.py` (estimator fit/predict/proba/importances, ranker
+groups, early stopping via eval_set, sklearn clone/get_params
+round-trips, plot_importance/plot_metric/plot_tree render checks).
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import LGBMClassifier, LGBMRanker, LGBMRegressor
+
+
+def _xy(n=800, f=6, seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = X[:, 0] * 2 + X[:, 1] + 0.2 * rng.normal(size=n)
+    return X, y.astype(np.float32)
+
+
+def test_regressor():
+    X, y = _xy()
+    reg = LGBMRegressor(n_estimators=25, num_leaves=15,
+                        learning_rate=0.2)
+    reg.fit(X, y)
+    p = reg.predict(X)
+    assert np.mean((p - y) ** 2) < 0.3 * np.var(y)
+    imp = reg.feature_importances_
+    assert imp.shape == (X.shape[1],)
+    assert imp[:2].sum() > imp[2:].sum()     # informative features win
+    assert reg.n_features_ == X.shape[1]
+
+
+def test_classifier_proba_and_classes():
+    X, y = _xy()
+    yc = (y > 0).astype(int)
+    clf = LGBMClassifier(n_estimators=20, num_leaves=15)
+    clf.fit(X, yc)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(X), 2)
+    np.testing.assert_allclose(proba.sum(axis=1), 1.0, atol=1e-5)
+    pred = clf.predict(X)
+    assert set(np.unique(pred)) <= set(clf.classes_)
+    assert (pred == yc).mean() > 0.9
+    assert clf.n_classes_ == 2
+
+
+def test_classifier_string_labels():
+    """Label encoding round-trips through non-numeric classes."""
+    X, y = _xy()
+    names = np.array(["neg", "pos"])
+    yc = names[(y > 0).astype(int)]
+    clf = LGBMClassifier(n_estimators=15, num_leaves=15)
+    clf.fit(X, yc)
+    assert set(clf.classes_) == {"neg", "pos"}
+    pred = clf.predict(X)
+    assert set(np.unique(pred)) <= {"neg", "pos"}
+    assert (pred == yc).mean() > 0.9
+
+
+def test_classifier_multiclass():
+    rng = np.random.RandomState(7)
+    X = rng.normal(size=(900, 5)).astype(np.float32)
+    y = np.argmax(X[:, :3], axis=1)
+    clf = LGBMClassifier(n_estimators=20, num_leaves=15)
+    clf.fit(X, y)
+    proba = clf.predict_proba(X)
+    assert proba.shape == (len(X), 3)
+    assert (clf.predict(X) == y).mean() > 0.85
+
+
+def test_ranker_groups():
+    rng = np.random.RandomState(5)
+    n_q, per = 40, 25
+    X = rng.normal(size=(n_q * per, 5)).astype(np.float32)
+    rel = np.clip((X[:, 0] * 1.3 + 1.5), 0, 4).astype(int)
+    rk = LGBMRanker(n_estimators=15, num_leaves=15,
+                    min_data_in_leaf=5)
+    rk.fit(X, rel, group=np.full(n_q, per))
+    s = rk.predict(X)
+    # within-query ordering correlates with relevance
+    corr = np.corrcoef(s, rel)[0, 1]
+    assert corr > 0.5, corr
+
+
+def test_early_stopping_via_eval_set():
+    X, y = _xy(seed=1)
+    Xv, yv = _xy(seed=2)
+    reg = LGBMRegressor(n_estimators=200, num_leaves=31,
+                        learning_rate=0.5)
+    reg.fit(X, y, eval_set=[(Xv, yv)], eval_metric="l2",
+            early_stopping_rounds=5, verbose=False)
+    assert reg.best_iteration_ < 200
+    assert "l2" in next(iter(reg.evals_result_.values()))
+
+
+def test_get_set_params_roundtrip():
+    """sklearn contract: get_params -> clone-by-ctor -> identical
+    params; set_params mutates in place."""
+    reg = LGBMRegressor(n_estimators=7, num_leaves=9, learning_rate=0.3)
+    params = reg.get_params()
+    reg2 = LGBMRegressor(**params)
+    assert reg2.get_params() == params
+    reg2.set_params(num_leaves=21)
+    assert reg2.get_params()["num_leaves"] == 21
+
+
+def test_callbacks_record_and_reset():
+    X, y = _xy()
+    Xv, yv = _xy(seed=9)
+    seen = {}
+    lrs = []
+
+    def spy(env):
+        lrs.append(env.params.get("learning_rate"))
+
+    lgb.train({"objective": "regression", "metric": "l2",
+               "num_leaves": 15, "learning_rate": 0.3},
+              lgb.Dataset(X, label=y), 8,
+              valid_sets=[lgb.Dataset(Xv, label=yv)],
+              callbacks=[lgb.record_evaluation(seen),
+                         lgb.reset_parameter(
+                             learning_rate=[0.3, 0.25, 0.2, 0.15, 0.1,
+                                            0.1, 0.1, 0.1]),
+                         spy],
+              verbose_eval=False)
+    assert "valid_0" in seen and len(seen["valid_0"]["l2"]) == 8
+    assert lrs[0] != lrs[-1]                 # reset_parameter applied
+
+
+def test_plotting_renders():
+    mpl = pytest.importorskip("matplotlib")
+    mpl.use("Agg")
+    X, y = _xy()
+    Xv, yv = _xy(seed=4)
+    evals = {}
+    bst = lgb.train({"objective": "regression", "metric": "l2",
+                     "num_leaves": 7}, lgb.Dataset(X, label=y), 6,
+                    valid_sets=[lgb.Dataset(Xv, label=yv)],
+                    evals_result=evals, verbose_eval=False)
+    ax = lgb.plot_importance(bst)
+    assert len(ax.patches) > 0               # bars rendered
+    ax2 = lgb.plot_metric(evals, metric="l2")
+    assert len(ax2.lines) >= 1
+    # the tree digraph needs no dot binary: check its structure
+    from lightgbm_tpu.plotting import create_tree_digraph
+    g = create_tree_digraph(bst, tree_index=0)
+    src = getattr(g, "source", str(g))
+    assert "split" in src or "leaf" in src
+
+
+def test_plot_tree_render():
+    """Full plot_tree render — needs the graphviz `dot` binary."""
+    mpl = pytest.importorskip("matplotlib")
+    mpl.use("Agg")
+    X, y = _xy()
+    bst = lgb.train({"objective": "regression", "num_leaves": 7},
+                    lgb.Dataset(X, label=y), 3, verbose_eval=False)
+    try:
+        ax = lgb.plot_tree(bst, tree_index=0)
+    except Exception as exc:            # noqa: BLE001
+        if "dot" in str(exc) or "graphviz" in str(exc).lower():
+            pytest.skip("graphviz binary not installed")
+        raise
+    assert ax is not None
