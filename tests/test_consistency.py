@@ -149,6 +149,57 @@ def test_cli_trains_reference_multiclass_example(tmp_path):
     assert acc > 0.4, acc             # 5 classes: random = 0.2
 
 
+def test_cli_predict_refit_convert_tasks(tmp_path):
+    """The reference CLI's other tasks (application.cpp task dispatch):
+    task=predict writes a result file matching the Python API's
+    predictions; task=refit re-estimates leaf values on new data;
+    task=convert_model emits compilable if-else C++."""
+    from lightgbm_tpu.cli import run
+    model = tmp_path / "model.txt"
+    conf = tmp_path / "train.conf"
+    conf.write_text(
+        "task = train\nobjective = binary\nmax_bin = 63\n"
+        "num_trees = 10\nnum_leaves = 15\nverbose = -1\n"
+        f"data = {REF_DIR}/binary.train\n"
+        f"output_model = {model}\n")
+    assert run([f"config={conf}"]) == 0
+
+    # --- task=predict -------------------------------------------------
+    result = tmp_path / "preds.tsv"
+    assert run(["task=predict", f"data={REF_DIR}/binary.test",
+                f"input_model={model}", f"output_result={result}",
+                "verbose=-1"]) == 0
+    preds = np.loadtxt(result)
+    test = np.loadtxt(f"{REF_DIR}/binary.test")
+    bst = Booster(model_file=str(model))
+    np.testing.assert_allclose(preds, bst.predict(test[:, 1:]), atol=1e-5)
+
+    # --- task=refit on the held-out file ------------------------------
+    refitted = tmp_path / "refit.txt"
+    assert run(["task=refit", f"data={REF_DIR}/binary.test",
+                f"input_model={model}", "objective=binary",
+                f"output_model={refitted}", "verbose=-1"]) == 0
+    rb = Booster(model_file=str(refitted))
+    # same structure, re-estimated leaf values
+    assert rb.num_trees() == bst.num_trees()
+    p_old = bst.predict(test[:, 1:], raw_score=True)
+    p_new = rb.predict(test[:, 1:], raw_score=True)
+    assert not np.allclose(p_old, p_new)
+
+    # --- task=convert_model: emitted C++ must compile -----------------
+    cpp = tmp_path / "model.cpp"
+    assert run(["task=convert_model", f"input_model={model}",
+                f"convert_model={cpp}", "verbose=-1"]) == 0
+    src = cpp.read_text()
+    assert "double" in src and "if" in src
+    import shutil
+    import subprocess
+    if shutil.which("g++"):
+        obj = tmp_path / "model.o"
+        subprocess.check_call(["g++", "-c", "-O1", str(cpp),
+                               "-o", str(obj)])
+
+
 def test_loads_reference_format_model_string():
     """A model string in the reference's exact v2 text layout
     (`gbdt_model_text.cpp:235-315`, `tree.cpp:209-242`) must parse and
