@@ -602,9 +602,11 @@ def predict_binned_matmul(stacked: StackedTrees,
       * per-node missing metadata via the same one-hot against the
         per-feature tables,
       * ``d2 = +-1`` decisions — numerical by threshold compare,
-        categorical by one vectorized in-VMEM lookup into the per-node
-        left-bin bitset ``cat_bin_mask`` (same semantics as the walk:
-        the bitset decides, missing bins simply aren't in the set),
+        categorical by a gather-free fold over the bin axis against the
+        per-node left-bin bitset ``cat_bin_mask`` (same semantics as
+        the walk: the bitset decides, missing bins simply aren't in the
+        set; a take_along_axis here compiled to a generalized gather
+        that faulted the TPU worker at scale),
       * ``S = P @ d2``; a row lands in leaf l iff ``S[l] == pathlen[l]``
         (exact: ±1 products, f32 MXU accumulation),
       * output = leaf one-hot contracted with leaf values (hi+lo bf16
@@ -678,11 +680,21 @@ def predict_binned_matmul(stacked: StackedTrees,
             tb = c["tb"].astype(jnp.float32)[:, :, None]
             dec = jnp.where(is_missing, c["dl"][:, :, None], cc <= tb)
             if any_cat:
-                # categorical: one vectorized in-VMEM bitset lookup per
-                # node (walk semantics — the bitset alone decides)
+                # categorical: bitset membership WITHOUT a gather — a
+                # take_along_axis here compiled to a generalized gather
+                # that faulted the TPU worker at 200k rows x 500 trees
+                # (the same fault class the matmul predictor exists to
+                # avoid); instead fold over the bin axis with dynamic
+                # slices: Bc (<=258) iterations of [tc, M, rc] compares
                 Bc = c["cm"].shape[2]
                 idx = jnp.minimum(cc.astype(jnp.int32), Bc - 1)
-                dec_cat = jnp.take_along_axis(c["cm"], idx, axis=2)
+
+                def cat_body(b, acc):
+                    hit = (idx == b) & c["cm"][:, :, b][:, :, None]
+                    return acc | hit
+
+                dec_cat = jax.lax.fori_loop(
+                    0, Bc, cat_body, jnp.zeros(idx.shape, bool))
                 dec = jnp.where(c["ic"][:, :, None], dec_cat, dec)
             d2 = jnp.where(dec, 1.0, -1.0).astype(jnp.bfloat16)
             S = jnp.einsum("tlm,tmr->tlr",
