@@ -294,10 +294,20 @@ class GBDT:
             from ..ops.pallas_histogram import transpose_bins
             # config hist_mode wins; env var / bf16 default otherwise
             # (the gpu_use_dp analog — ADVICE r2)
-            hist_mode = c.hist_mode or default_hist_mode()
+            from ..learner.serial import effective_hist_mode
+            hist_mode = effective_hist_mode(
+                c.hist_mode or default_hist_mode(), self.num_data)
             self._bins_t = None
-            if resolve_backend(self.device_data, growth.num_leaves,
-                               hist_mode=hist_mode) == "pallas":
+            backend = resolve_backend(self.device_data, growth.num_leaves,
+                                      hist_mode=hist_mode)
+            # the fused 32-iteration block is only safe on the Pallas
+            # backend: 32 chained SCATTER tree builds in one program
+            # exceeded the device watchdog and killed the worker at
+            # >256 bins x 300k rows (r4); scatter configs dispatch
+            # per-iteration instead
+            self._block_backend_ok = (jax.default_backend() != "tpu"
+                                      or backend == "pallas")
+            if backend == "pallas":
                 bins_host = (self.train_set.bins
                              if self.train_set is not None else None)
                 if (bins_host is not None
@@ -845,7 +855,8 @@ class GBDT:
                 and self.fobj is None
                 and self.objective is not None
                 and not self.objective.need_renew_tree_output
-                and not self._valid_device)
+                and not self._valid_device
+                and getattr(self, "_block_backend_ok", True))
 
     def _block_fn(self, cap: int):
         """A jitted fixed-length-``cap`` scan block.  Iterations past
