@@ -436,6 +436,169 @@ long ltpu_parse_libsvm_chunk(const char* path, long long offset, long skip,
   return rows;
 }
 
+}  // extern "C"
+
+// ---------------------------------------------------------------------------
+// Exact TreeSHAP over flat tree arrays (the native hot loop behind
+// boosting/contrib.py — the reference runs the same polynomial-time
+// algorithm in C++, src/io/tree.cpp TreeSHAP).  The Python layer dedups
+// rows into distinct per-node decision PATTERNS; this runs the
+// recursion once per pattern.
+// ---------------------------------------------------------------------------
+namespace {
+
+struct ShapPath {
+  int feature_index;
+  double zero_fraction;
+  double one_fraction;
+  double pweight;
+};
+
+struct ShapTree {
+  long m, L, F;
+  const unsigned char* D;       // current pattern row [m]
+  const int* split_feature;     // [m]
+  const int* left_child;        // [m] (<0 == ~leaf)
+  const int* right_child;       // [m]
+  const double* leaf_value;     // [L]
+  const double* internal_count; // [m]
+  const double* leaf_count;     // [L]
+};
+
+void shap_extend(std::vector<ShapPath>& path, int unique_depth,
+                 double zero_fraction, double one_fraction,
+                 int feature_index) {
+  path.push_back({feature_index, zero_fraction, one_fraction,
+                  unique_depth == 0 ? 1.0 : 0.0});
+  for (int i = unique_depth - 1; i >= 0; --i) {
+    path[i + 1].pweight += one_fraction * path[i].pweight * (i + 1)
+                           / (unique_depth + 1);
+    path[i].pweight = zero_fraction * path[i].pweight
+                      * (unique_depth - i) / double(unique_depth + 1);
+  }
+}
+
+void shap_unwind(std::vector<ShapPath>& path, int unique_depth,
+                 int path_index) {
+  double one_fraction = path[path_index].one_fraction;
+  double zero_fraction = path[path_index].zero_fraction;
+  double next_one_portion = path[unique_depth].pweight;
+  for (int i = unique_depth - 1; i >= 0; --i) {
+    if (one_fraction != 0.0) {
+      double tmp = path[i].pweight;
+      path[i].pweight = next_one_portion * (unique_depth + 1)
+                        / ((i + 1) * one_fraction);
+      next_one_portion = tmp - path[i].pweight * zero_fraction
+                         * (unique_depth - i) / double(unique_depth + 1);
+    } else {
+      path[i].pweight = path[i].pweight * (unique_depth + 1)
+                        / (zero_fraction * (unique_depth - i));
+    }
+  }
+  for (int i = path_index; i < unique_depth; ++i) {
+    path[i].feature_index = path[i + 1].feature_index;
+    path[i].zero_fraction = path[i + 1].zero_fraction;
+    path[i].one_fraction = path[i + 1].one_fraction;
+  }
+  path.pop_back();
+}
+
+double shap_unwound_sum(const std::vector<ShapPath>& path, int unique_depth,
+                        int path_index) {
+  double one_fraction = path[path_index].one_fraction;
+  double zero_fraction = path[path_index].zero_fraction;
+  double next_one_portion = path[unique_depth].pweight;
+  double total = 0.0;
+  for (int i = unique_depth - 1; i >= 0; --i) {
+    if (one_fraction != 0.0) {
+      double tmp = next_one_portion * (unique_depth + 1)
+                   / ((i + 1) * one_fraction);
+      total += tmp;
+      next_one_portion = path[i].pweight - tmp * zero_fraction
+                         * ((unique_depth - i) / double(unique_depth + 1));
+    } else {
+      total += path[i].pweight / zero_fraction
+               / ((unique_depth - i) / double(unique_depth + 1));
+    }
+  }
+  return total;
+}
+
+double shap_node_count(const ShapTree& t, int node) {
+  if (node < 0) return t.leaf_count[~node];
+  return t.internal_count[node];
+}
+
+void shap_recurse(const ShapTree& t, double* phi, int node,
+                  int unique_depth, const std::vector<ShapPath>& parent,
+                  double parent_zero_fraction, double parent_one_fraction,
+                  int parent_feature_index) {
+  std::vector<ShapPath> path(parent);
+  shap_extend(path, unique_depth, parent_zero_fraction,
+              parent_one_fraction, parent_feature_index);
+
+  if (node < 0) {                      // leaf
+    double lv = t.leaf_value[~node];
+    for (int i = 1; i <= unique_depth; ++i) {
+      double w = shap_unwound_sum(path, unique_depth, i);
+      const ShapPath& el = path[i];
+      phi[el.feature_index] += w * (el.one_fraction - el.zero_fraction)
+                               * lv;
+    }
+    return;
+  }
+
+  int hot = t.D[node] ? t.left_child[node] : t.right_child[node];
+  int cold = t.D[node] ? t.right_child[node] : t.left_child[node];
+  double w = t.internal_count[node];
+  double hot_count = shap_node_count(t, hot);
+  double cold_count = shap_node_count(t, cold);
+
+  double incoming_zero_fraction = 1.0;
+  double incoming_one_fraction = 1.0;
+  int feature = t.split_feature[node];
+  int path_index = -1;
+  for (int i = 1; i <= unique_depth; ++i) {
+    if (path[i].feature_index == feature) { path_index = i; break; }
+  }
+  if (path_index >= 0) {
+    incoming_zero_fraction = path[path_index].zero_fraction;
+    incoming_one_fraction = path[path_index].one_fraction;
+    shap_unwind(path, unique_depth, path_index);
+    unique_depth -= 1;
+  }
+
+  shap_recurse(t, phi, hot, unique_depth + 1, path,
+               hot_count / w * incoming_zero_fraction,
+               incoming_one_fraction, feature);
+  shap_recurse(t, phi, cold, unique_depth + 1, path,
+               cold_count / w * incoming_zero_fraction, 0.0, feature);
+}
+
+}  // namespace
+
+extern "C" {
+
+// phi_out [P, F+1] must be pre-zeroed; returns 0 on success.
+long ltpu_treeshap(long P, long m, long L, long F,
+                   const unsigned char* D, const int* split_feature,
+                   const int* left_child, const int* right_child,
+                   const double* leaf_value, const double* internal_count,
+                   const double* leaf_count, double* phi_out) {
+  // patterns are independent (disjoint phi rows): parallelize like the
+  // reference's OpenMP row loop (tree.cpp PredictContrib callers)
+#ifdef _OPENMP
+#pragma omp parallel for schedule(dynamic, 16)
+#endif
+  for (long p = 0; p < P; ++p) {
+    ShapTree t{m, L, F, D + p * m, split_feature, left_child, right_child,
+               leaf_value, internal_count, leaf_count};
+    std::vector<ShapPath> empty;
+    shap_recurse(t, phi_out + p * (F + 1), 0, 0, empty, 1.0, 1.0, -1);
+  }
+  return 0;
+}
+
 void ltpu_free(double* p) { std::free(p); }
 
 }  // extern "C"
