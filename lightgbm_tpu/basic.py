@@ -328,6 +328,25 @@ class Booster:
         return self._gbdt.predict(X, raw_score=raw_score,
                                   num_iteration=num_iteration)
 
+    def refit(self, data, label, decay_rate: float = 0.9,
+              **kwargs) -> "Booster":
+        """Re-fit the existing tree structures' leaf values on new data
+        (reference python-package ``Booster.refit`` over
+        ``LGBM_BoosterRefit`` / RefitTree, gbdt.cpp:268-280):
+        ``new_leaf = decay_rate * old + (1 - decay_rate) * refit``.
+        Returns a NEW Booster; this one is untouched.  ``kwargs`` apply
+        to BOTH the refit dataset and the new booster's config
+        (lambda_l1/l2 etc. steer the refit leaf estimates)."""
+        params = dict(self.params)
+        params.update(kwargs)
+        new = Booster(params=params, model_str=self.model_to_string())
+        if kwargs:
+            new._gbdt.reset_config(params)
+        ds = Dataset(data, label=label, params=params)
+        ds.construct()
+        new._gbdt.refit_dataset(ds._constructed, decay_rate=decay_rate)
+        return new
+
     # -- model IO -------------------------------------------------------
     def save_model(self, filename, num_iteration=-1):
         if num_iteration is None or num_iteration <= 0:

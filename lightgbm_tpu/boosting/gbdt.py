@@ -1476,9 +1476,42 @@ class GBDT:
         return infos
 
     # ------------------------------------------------------------------
-    def refit(self, pred_leaf: np.ndarray) -> None:
+    def refit_dataset(self, ds: BinnedDataset,
+                      decay_rate: float = 0.9) -> None:
+        """Re-estimate every tree's leaf values on a NEW dataset keeping
+        the structures (reference RefitTree, gbdt.cpp:268-280 +
+        application.cpp:293-318): attach the dataset, re-map each
+        tree's thresholds through its mappers, and refit from the new
+        rows' leaf assignments.  Shared by CLI task=refit and
+        Booster.refit.  The EXISTING objective (e.g. parsed from the
+        model header) is kept; one is created from the config only when
+        none is set — a model loaded without params must not silently
+        refit binary trees with the default regression gradients."""
+        self.train_set = ds
+        for t in self.models:
+            t.align_with_mappers(
+                ds.mappers, {f: i for i, f in enumerate(ds.used_features)})
+        self.device_data = to_device(ds)
+        self.num_data = ds.num_data
+        if self.objective is None:
+            self.objective = create_objective(self.config)
+        self.objective.init(ds.metadata, ds.num_data)
+        K = self.num_tree_per_iteration
+        self.scores = jnp.zeros((ds.num_data, K), jnp.float32)
+        from ..models.tree import predict_leaf_binned
+        dd = self.device_data
+        st = stack_trees(self.models, max_bins=dd.max_bins)
+        pred_leaf = np.asarray(predict_leaf_binned(
+            st, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types))
+        self.refit(pred_leaf, decay_rate=decay_rate)
+
+    def refit(self, pred_leaf: np.ndarray,
+              decay_rate: float = 0.9) -> None:
         """Refit leaf outputs with new data (reference RefitTree
-        gbdt.cpp:329-351 / FitByExistingTree)."""
+        gbdt.cpp:329-351 / FitByExistingTree + the python package's
+        refit decay): ``new = decay_rate * old + (1 - decay_rate) *
+        refit_output``; leaves no new row reaches keep their old output
+        (a 0/0 would poison them with NaN for future rows)."""
         grad, hess = self._gradients()
         K = self.num_tree_per_iteration
         g = np.asarray(grad)
@@ -1490,11 +1523,17 @@ class GBDT:
             nl = tree.num_leaves
             sg = np.zeros(nl)
             sh = np.zeros(nl)
+            cnt = np.zeros(nl)
             np.add.at(sg, leaves, g[:, k])
             np.add.at(sh, leaves, h[:, k])
-            from ..ops.split import threshold_l1
+            np.add.at(cnt, leaves, 1.0)
             for l in range(nl):
+                if cnt[l] == 0:
+                    continue           # untouched leaf keeps its output
                 out = -(np.sign(sg[l]) * max(abs(sg[l]) - c.lambda_l1, 0.0)) \
                     / (sh[l] + c.lambda_l2)
-                tree.set_leaf_output(l, out * self.shrinkage_rate)
+                old = float(tree.leaf_value[l])
+                tree.set_leaf_output(
+                    l, decay_rate * old
+                    + (1.0 - decay_rate) * out * self.shrinkage_rate)
         self._stacked_cache = None

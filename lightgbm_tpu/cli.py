@@ -169,28 +169,7 @@ def _run_refit(cfg: Config, params) -> None:
     booster = Booster(params=dict(params), model_file=cfg.input_model)
     data = Dataset(cfg.data, params=dict(params))
     data.construct()
-    ds = data._constructed
-    g = booster._gbdt
-    g.train_set = ds
-    for t in g.models:
-        t.align_with_mappers(ds.mappers,
-                             {f: i for i, f in enumerate(ds.used_features)})
-    from .io.device import to_device
-    g.device_data = to_device(ds)
-    g.num_data = ds.num_data
-    from .objective.objectives import create_objective
-    g.objective = create_objective(cfg)
-    g.objective.init(ds.metadata, ds.num_data)
-    K = g.num_tree_per_iteration
-    import jax.numpy as jnp
-    g.scores = jnp.zeros((ds.num_data, K), jnp.float32)
-    # leaf indices of each row under each tree
-    from .models.tree import stack_trees, predict_leaf_binned
-    dd = g.device_data
-    st = stack_trees(g.models, max_bins=dd.max_bins)
-    pred_leaf = np.asarray(predict_leaf_binned(
-        st, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types))
-    g.refit(pred_leaf)
+    booster._gbdt.refit_dataset(data._constructed)
     booster.save_model(cfg.output_model)
     log_info(f"finished refit; model saved to {cfg.output_model}")
 

@@ -435,3 +435,29 @@ def test_transient_dispatch_retry():
 
     with pytest.raises(RuntimeError, match="INVALID_ARGUMENT"):
         g._dispatch_retry(fatal)
+
+
+def test_booster_refit():
+    """Reference Booster.refit: leaf values re-estimated on new data,
+    structures unchanged, original booster untouched; leaves no new
+    row reaches keep their old output (no NaN poisoning)."""
+    X, y = _binary_data(seed=30)
+    bst = lgb.train({"objective": "binary", "num_leaves": 15},
+                    lgb.Dataset(X, label=y), 10, verbose_eval=False)
+    X2, y2 = _binary_data(seed=31)
+    new = bst.refit(X2, y2)
+    assert new is not bst
+    assert new.num_trees() == bst.num_trees()
+    p_old = bst.predict(X2[:200], raw_score=True)
+    p_new = new.predict(X2[:200], raw_score=True)
+    assert np.isfinite(p_new).all()
+    assert not np.allclose(p_old, p_new)
+    # structures identical: same split features per tree (threshold
+    # BINS re-map to the new dataset's mappers by design)
+    for a, b in zip(bst._gbdt.models, new._gbdt.models):
+        m = a.num_leaves - 1
+        assert a.num_leaves == b.num_leaves
+        np.testing.assert_array_equal(a.split_feature[:m],
+                                      b.split_feature[:m])
+    # quality on the refit data improves over the stale model
+    assert _auc(y2, new.predict(X2)) >= _auc(y2, bst.predict(X2)) - 0.01
