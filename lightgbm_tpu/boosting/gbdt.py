@@ -76,8 +76,11 @@ def _device_feature_mask(seed: int, tree_idx, F: int, k: int):
     no sequential draws — and traceable inside ``lax.scan``."""
     key = jax.random.fold_in(jax.random.PRNGKey(seed), tree_idx)
     r = jax.random.uniform(key, (F,))
-    kth = jax.lax.top_k(r, k)[0][-1]
-    return r >= kth
+    # scatter the top-k INDICES into a boolean mask: a `r >= kth`
+    # threshold admits every tied draw (2^-24 uniform granularity) and
+    # breaks the exactly-k contract over hundreds of trees (ADVICE r4)
+    idx = jax.lax.top_k(r, k)[1]
+    return jnp.zeros(F, bool).at[idx].set(True)
 
 
 def split_params_from_config(c: Config) -> SplitParams:
@@ -1511,29 +1514,60 @@ class GBDT:
         gbdt.cpp:329-351 / FitByExistingTree + the python package's
         refit decay): ``new = decay_rate * old + (1 - decay_rate) *
         refit_output``; leaves no new row reaches keep their old output
-        (a 0/0 would poison them with NaN for future rows)."""
-        grad, hess = self._gradients()
+        (a 0/0 would poison them with NaN for future rows).
+
+        Sequential like the reference (ADVICE r4): the refit task
+        (application.cpp:293-318) calls ``GBDT::Init`` with the new
+        data, creating a FRESH ScoreUpdater — scores start at the
+        dataset's init_score (or zero), with no old-model replay —
+        then RefitTree's loop recomputes gradients at the current
+        scores per iteration (``Boosting()``), refits that iteration's
+        K trees, and ADDS each refitted tree's output to the scores
+        (``AddScore``), so iteration i+1 fits the residual after
+        refitted iteration i.  On exit ``self.scores`` equals the
+        refitted model's prediction, preserving the invariant every
+        other mutation path (rollback/merge/set_leaf_value) keeps."""
         K = self.num_tree_per_iteration
-        g = np.asarray(grad)
-        h = np.asarray(hess)
+        models = self.models
         c = self.config
-        for i, tree in enumerate(self.models):
-            k = i % K
-            leaves = pred_leaf[:, i]
-            nl = tree.num_leaves
-            sg = np.zeros(nl)
-            sh = np.zeros(nl)
-            cnt = np.zeros(nl)
-            np.add.at(sg, leaves, g[:, k])
-            np.add.at(sh, leaves, h[:, k])
-            np.add.at(cnt, leaves, 1.0)
-            for l in range(nl):
-                if cnt[l] == 0:
-                    continue           # untouched leaf keeps its output
-                out = -(np.sign(sg[l]) * max(abs(sg[l]) - c.lambda_l1, 0.0)) \
-                    / (sh[l] + c.lambda_l2)
-                old = float(tree.leaf_value[l])
-                tree.set_leaf_output(
-                    l, decay_rate * old
-                    + (1.0 - decay_rate) * out * self.shrinkage_rate)
+        n = pred_leaf.shape[0]
+        scores_np = np.zeros((n, K), np.float32)
+        ms = (self.train_set.metadata.init_score
+              if self.train_set is not None else None)
+        if ms is not None:
+            scores_np = np.asarray(ms, np.float64).reshape(
+                -1, K, order="F").astype(np.float32)
+        for it in range(len(models) // K):
+            self.scores = jnp.asarray(scores_np)
+            grad, hess = self._gradients()
+            g = np.asarray(grad)
+            h = np.asarray(hess)
+            for k in range(K):
+                i = it * K + k
+                tree = models[i]
+                leaves = pred_leaf[:, i]
+                nl = tree.num_leaves
+                sg = np.zeros(nl)
+                sh = np.zeros(nl)
+                cnt = np.zeros(nl)
+                np.add.at(sg, leaves, g[:, k])
+                np.add.at(sh, leaves, h[:, k])
+                np.add.at(cnt, leaves, 1.0)
+                old = np.asarray(tree.leaf_value[:nl], np.float64)
+                with np.errstate(divide="ignore", invalid="ignore"):
+                    out = (-(np.sign(sg)
+                             * np.maximum(np.abs(sg) - c.lambda_l1, 0.0))
+                           / (sh + c.lambda_l2))
+                new_vals = np.where(
+                    cnt > 0,
+                    decay_rate * old
+                    + (1.0 - decay_rate) * out * self.shrinkage_rate,
+                    old)                # untouched leaf keeps its output
+                for l in range(nl):
+                    tree.set_leaf_output(l, float(new_vals[l]))
+                # AddScore: the refitted tree's output joins the scores
+                # the NEXT iteration's gradients see
+                scores_np[:, k] += np.asarray(
+                    tree.leaf_value[:nl], np.float32)[leaves]
+        self.scores = jnp.asarray(scores_np)
         self._stacked_cache = None

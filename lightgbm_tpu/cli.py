@@ -84,10 +84,16 @@ def _init_network(cfg: Config) -> None:
     own rank by finding its local endpoint in the list."""
     # already-meshed check WITHOUT touching the backend
     # (jax.process_count() would initialize XLA, and
-    # jax.distributed.initialize must come first)
-    from jax._src import distributed as _dist
-    if getattr(_dist.global_state, "client", None) is not None:
-        return                              # environment already meshed
+    # jax.distributed.initialize must come first).  The probe reads a
+    # private jax layout, so it is best-effort: on a jax whose internals
+    # moved, fall through and let initialize's own already-initialized
+    # error be the signal (ADVICE r4)
+    try:
+        from jax._src import distributed as _dist
+        if getattr(_dist.global_state, "client", None) is not None:
+            return                          # environment already meshed
+    except (ImportError, AttributeError):
+        pass
     from .parallel.mesh import init_distributed_from_machines
     machines = cfg.machines
     if not machines and cfg.machine_list_file:

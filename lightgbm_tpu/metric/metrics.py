@@ -165,6 +165,19 @@ class BinaryErrorMetric(Metric):
                                         weight), False)]
 
 
+_WARNED_DEGENERATE_AUC: set = set()
+
+
+def _warn_degenerate_auc(msg: str) -> None:
+    """Warn ONCE per degenerate-AUC condition per process: eval runs
+    every iteration, and the reference warns a single time at metric
+    Init (binary_metric.hpp), not per evaluation."""
+    if msg not in _WARNED_DEGENERATE_AUC:
+        _WARNED_DEGENERATE_AUC.add(msg)
+        from ..utils.log import log_warning
+        log_warning(msg)
+
+
 def binary_auc(label, score, weight=None):
     """Tie-aware rank-sum AUC with weights (binary_metric.hpp:157-234
     semantics, computed by sort + cumulative sums instead of bucket
@@ -173,8 +186,11 @@ def binary_auc(label, score, weight=None):
     label = np.asarray(label)
     score = np.asarray(score)
     if len(label) == 0:
-        return 1.0       # degenerate input: same value as the all-one-
-    #                      class guard below (reduceat rejects empty)
+        # degenerate input (e.g. an empty valid set or a zero-row rank
+        # shard): NaN, never a silent perfect score (ADVICE r4)
+        _warn_degenerate_auc("AUC over an empty set is undefined; "
+                             "returning NaN")
+        return float("nan")
     order = np.argsort(score, kind="mergesort")
     s = score[order]
     y = label[order]
@@ -198,6 +214,10 @@ def binary_auc(label, score, weight=None):
     total_pos = wp.sum()
     total_neg = wn.sum()
     if total_pos == 0 or total_neg == 0:
+        # the reference warns and skips AUC when a class is absent
+        # (binary_metric.hpp Init); keep the conventional 1.0 but say so
+        _warn_degenerate_auc("AUC over a single-class set is degenerate; "
+                             "reporting 1.0")
         return 1.0
     return float(area / (total_pos * total_neg))
 

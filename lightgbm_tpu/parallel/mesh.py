@@ -42,7 +42,18 @@ def init_distributed(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    jax.distributed.initialize(**kwargs)
+    try:
+        jax.distributed.initialize(**kwargs)
+    except RuntimeError as exc:
+        # idempotent entry: the CLI's already-meshed probe reads private
+        # jax state and may miss on a future jax — double-initialize
+        # must then degrade to a no-op, not a crash (ADVICE r4).
+        # jax 0.9 phrases it "distributed.initialize should only be
+        # called once."; older builds say "already initialized"
+        msg = str(exc).lower()
+        if ("already initialized" not in msg
+                and "only be called once" not in msg):
+            raise
 
 
 def init_distributed_from_machines(machines: str, local_listen_port: int,

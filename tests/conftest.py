@@ -25,3 +25,12 @@ os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "0")
 import jax  # noqa: E402  (must come after the env setup above)
 
 jax.config.update("jax_platforms", "cpu")
+
+
+def pytest_configure(config):
+    # bench-shaped tests (minutes each on the CPU mesh) carry this
+    # marker; default runs include them, `-m "not slow"` is the fast
+    # loop (documented in README "Running the tests")
+    config.addinivalue_line(
+        "markers", "slow: bench-shaped test (minutes on the CPU mesh); "
+        "deselect with -m 'not slow'")
