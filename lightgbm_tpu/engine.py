@@ -97,15 +97,34 @@ def train(params: Dict[str, Any], train_set: Dataset,
     cbs_before.sort(key=lambda cb: getattr(cb, "order", 0))
     cbs_after.sort(key=lambda cb: getattr(cb, "order", 0))
 
-    # fast path: with nothing to observe between iterations (no valid
-    # sets, no feval/fobj, no user callbacks), the whole run batches into
-    # fused device blocks (GBDT.train_block) — one dispatch per window
-    # instead of ~15 ops/iteration through the device tunnel
-    if (fobj is None and not valid_sets
-            and not params.get("is_training_metric")
-            and not callbacks and not early_stopping_rounds
+    # fast path: with nothing per-iteration to call back into (no
+    # feval/fobj, no user callbacks, no per-iteration records), the
+    # whole run batches into fused device blocks (GBDT.train_block) —
+    # one dispatch per window instead of ~15 ops/iteration through the
+    # device tunnel.  Valid sets + early stopping STAY on this path
+    # (r5): valid scoring runs inside the blocks on device and the
+    # stop check runs at output_freq window boundaries (set
+    # ``output_freq``/``metric_freq`` to trade eval granularity for
+    # window length; the reference CLI's metric cadence knob).
+    if (fobj is None and feval is None and not callbacks
             and evals_result is None and learning_rates is None):
-        booster._gbdt.train(num_boost_round)   # windows into train_block
+        g = booster._gbdt
+        if params.get("is_training_metric"):
+            # set above when train_set appears in valid_sets — AFTER the
+            # booster's config snapshot, so it must be forwarded or the
+            # fast path silently drops training-metric reporting
+            g.config.is_training_metric = True
+        if early_stopping_rounds and early_stopping_rounds > 0:
+            if not g.valid_sets:
+                # the callback path fails fast on this misconfiguration
+                # (callback.py early_stopping init); match it
+                raise ValueError("For early stopping, at least one "
+                                 "validation set is required")
+            g.config.early_stopping_round = int(early_stopping_rounds)
+        g.train(num_boost_round)               # windows into train_block
+        if g.best_iteration > 0:
+            booster.best_iteration = g.best_iteration
+            booster.best_score = dict(g.best_score)
         if booster.best_iteration <= 0:
             booster.best_iteration = booster.current_iteration
         if not keep_training_booster:

@@ -829,3 +829,89 @@ def predict_built_tree(tree: BuiltTree, data: DeviceData,
     node = jax.lax.fori_loop(0, depth, body, node)
     leaf = jnp.where(node < 0, ~node, 0)
     return tree.leaf_value[leaf]
+
+
+def built_tree_path_matrices(tree: BuiltTree):
+    """Signed leaf-path matrices of a just-built DEVICE tree, traceably
+    (the device analog of ``models/tree.py build_path_matrices``, which
+    walks host trees with a Python stack).
+
+    ``P[l, m]`` is +1 / -1 when internal node ``m`` lies on leaf ``l``'s
+    root path going left / right, else 0; ``plen[l]`` is the leaf's
+    depth (-1 for unused slots, so they can never be selected).  Node
+    indices are creation-ordered — a child's index always exceeds its
+    parent's — so ONE ascending ``fori_loop`` over the node axis
+    propagates root paths with tiny ``[L, M]`` state per step; the
+    per-ROW work is deferred to a single MXU contraction in
+    ``predict_built_tree_matmul``.  Conditional scatters write to a
+    trailing dummy slot, the scan-safe alternative to predication."""
+    L = tree.leaf_value.shape[0]
+    M = max(L - 1, 1)
+    nodeP = jnp.zeros((M + 1, M), jnp.float32)
+    node_len = jnp.zeros(M + 1, jnp.int32)
+    leafP = jnp.zeros((L + 1, M), jnp.float32)
+    # stump: leaf 0's zero-length path matches S == 0
+    plen = jnp.where((jnp.arange(L + 1) == 0) & (tree.num_leaves <= 1),
+                     0, -1).astype(jnp.int32)
+
+    def body(m, carry):
+        nodeP, node_len, leafP, plen = carry
+        real = m < tree.num_leaves - 1
+        blen = node_len[m] + 1
+        for child_arr, sign in ((tree.left_child, 1.0),
+                                (tree.right_child, -1.0)):
+            c = child_arr[m]
+            path = nodeP[m].at[m].set(sign)
+            is_leaf = c < 0
+            li = jnp.where(real & is_leaf, ~c, L)
+            ni = jnp.where(real & ~is_leaf, c, M)
+            leafP = leafP.at[li].set(path)
+            plen = plen.at[li].set(blen)
+            nodeP = nodeP.at[ni].set(path)
+            node_len = node_len.at[ni].set(blen)
+        return nodeP, node_len, leafP, plen
+
+    _, _, leafP, plen = jax.lax.fori_loop(
+        0, M, body, (nodeP, node_len, leafP, plen))
+    return leafP[:L], plen[:L]
+
+
+def predict_built_tree_matmul(tree: BuiltTree, data: DeviceData,
+                              bins: jnp.ndarray) -> jnp.ndarray:
+    """Leaf value per row of ``bins`` with NO per-row tree walk: every
+    node decision at once + one path-agreement contraction (the in-scan
+    valid-set scorer; same algorithm as ``predict_binned_matmul`` but
+    for a single device-resident ``BuiltTree``).
+
+    Steps (all exact): per-node bin values via a one-hot matmul against
+    the stored columns (f32 operands — generalized gathers over
+    ``[n, M]`` faulted the TPU worker at scale, r4), EFB unbundling +
+    missing handling per node, ``d2 = ±1`` decisions, ``S = d2 @ P^T``
+    and the leaf is the unique ``l`` with ``S[l] == plen[l]``.
+    Numerical splits only — callers route categorical valid sets
+    through ``predict_built_tree``."""
+    from ..ops.pallas_route import unbundle_bin
+    P, plen = built_tree_path_matrices(tree)
+    f = tree.feature                              # [M] used-column ids
+    G = bins.shape[1]
+    # c[m, n]: node m's stored column value per row, as one matmul
+    oh = jax.nn.one_hot(data.feat_group[f], G, dtype=jnp.float32)
+    c = jax.lax.dot_general(
+        oh, bins.astype(jnp.float32), (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [M, n]
+    b = unbundle_bin(c.astype(jnp.int32), data.feat_offset[f][:, None],
+                     data.num_bins[f][:, None], data.default_bins[f][:, None])
+    mt = data.missing_types[f][:, None]
+    is_missing = (((mt == MISSING_NAN) & (b == data.nan_bins[f][:, None]))
+                  | ((mt == MISSING_ZERO)
+                     & (b == data.default_bins[f][:, None])))
+    go_left = jnp.where(is_missing, tree.default_left[:, None],
+                        b <= tree.threshold_bin[:, None])
+    d2 = (2.0 * go_left - 1.0).astype(jnp.bfloat16)          # [M, n] ±1
+    # S[l, n] = sum_m P[l, m] * d2[m, n]; ±1 operands with f32
+    # accumulation keep integer path sums exact up to |plen| <= M
+    S = jax.lax.dot_general(
+        P.astype(jnp.bfloat16), d2, (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32)       # [L, n]
+    sel = (S == plen[:, None].astype(jnp.float32)) & (plen[:, None] >= 0)
+    return jnp.sum(jnp.where(sel, tree.leaf_value[:, None], 0.0), axis=0)

@@ -1,0 +1,188 @@
+"""Device-side valid-set scoring inside the fused block.
+
+The standard train-with-valid + early-stopping workflow must stay on the
+fused block path (the reference scores validation data per tree without
+decelerating training, `gbdt.cpp:492+`, `score_updater.hpp:54-100`; on a
+remote TPU falling off the block path costs ~100 ms/iteration of host
+dispatches).  Covers: the path-agreement matmul scorer vs the node-walk
+oracle, block/per-iteration bit-identity with valid sets attached
+(numerical + categorical), and early stopping riding the block path.
+"""
+import os
+
+import jax.numpy as jnp
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.device import to_device
+from lightgbm_tpu.learner.serial import (GrowthParams, SplitParams,
+                                         build_tree, predict_built_tree,
+                                         predict_built_tree_matmul)
+
+
+def _data(seed, n=2000, f=8, missing=False):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    if missing:
+        X[rng.uniform(size=X.shape) < 0.1] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1])
+         + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def test_matmul_valid_scorer_matches_walk():
+    """predict_built_tree_matmul == predict_built_tree on a valid set
+    binned through the train mappers, incl. NaN missing routing."""
+    X, y = _data(0, missing=True)
+    cfg = Config.from_params({"max_bin": 63})
+    ds = BinnedDataset.from_raw(X, cfg)
+    dd = to_device(ds)
+    Xv, _ = _data(1, n=999, missing=True)
+    vd = to_device(ds.create_valid(Xv, prediction_mode=True))
+    g = jnp.asarray(1.0 - 2.0 * y)
+    h = jnp.ones(len(y))
+    p = GrowthParams(num_leaves=31, split=SplitParams(min_data_in_leaf=5))
+    bt = build_tree(dd, g, h, p)
+    assert int(bt.num_leaves) > 2
+    walk = np.asarray(predict_built_tree(bt, vd, vd.bins))
+    mm = np.asarray(predict_built_tree_matmul(bt, vd, vd.bins))
+    np.testing.assert_array_equal(mm, walk)
+
+
+def test_matmul_valid_scorer_stump():
+    """A stump tree (no split possible) must score leaf 0 everywhere."""
+    X, y = _data(2, n=64)
+    cfg = Config.from_params({"max_bin": 15})
+    ds = BinnedDataset.from_raw(X, cfg)
+    dd = to_device(ds)
+    p = GrowthParams(num_leaves=7,
+                     split=SplitParams(min_data_in_leaf=1000))
+    bt = build_tree(dd, jnp.asarray(1.0 - 2.0 * y), jnp.ones(len(y)), p)
+    assert int(bt.num_leaves) == 1
+    walk = np.asarray(predict_built_tree(bt, dd, dd.bins))
+    mm = np.asarray(predict_built_tree_matmul(bt, dd, dd.bins))
+    np.testing.assert_array_equal(mm, walk)
+
+
+def _train_pair(params, n_iters, categorical=False):
+    """Train block-path vs forced per-iteration; return both boosters."""
+    X, y = _data(0, missing=True)
+    Xv, yv = _data(1, n=1111, missing=True)
+    if categorical:
+        rng = np.random.RandomState(7)
+        X[:, -1] = rng.randint(0, 12, size=len(X))
+        Xv[:, -1] = rng.randint(0, 12, size=len(Xv))
+        params = dict(params, categorical_feature=[7])
+    out = []
+    for no_block in (False, True):
+        if no_block:
+            os.environ["LGBM_TPU_NO_BLOCK"] = "1"
+        try:
+            ds = lgb.Dataset(X, label=y, params=params)
+            vs = lgb.Dataset(Xv, label=yv, reference=ds)
+            bst = lgb.train(params, ds, n_iters, valid_sets=[vs],
+                            valid_names=["v0"], verbose_eval=False,
+                            keep_training_booster=True)
+            g = bst._gbdt
+            assert g._can_block() != no_block or no_block
+            out.append((bst.model_to_string(),
+                        np.asarray(g._valid_scores[0])))
+        finally:
+            os.environ.pop("LGBM_TPU_NO_BLOCK", None)
+    return out
+
+
+def test_block_with_valid_matches_per_iteration():
+    """Fused-block training with a valid set attached matches the
+    per-iteration path (bagging + feature_fraction active, so the
+    sampled paths agree too).  atol covers float32 fusion/op-ordering
+    drift between the jitted scan block and the eager path — the same
+    envelope the existing block-identity tests use; a routing or mask
+    divergence would show as O(1e-2) differences."""
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+              "verbose": -1, "output_freq": 10, "bagging_freq": 2,
+              "bagging_fraction": 0.7, "feature_fraction": 0.8}
+    (m_blk, v_blk), (m_it, v_it) = _train_pair(params, 30)
+    assert m_blk.count("Tree=") == m_it.count("Tree=")
+    np.testing.assert_allclose(v_blk, v_it, atol=1e-5)
+
+
+def test_block_with_categorical_valid_matches_per_iteration():
+    """Categorical valid sets take the in-scan node walk (bitset
+    decisions); the block path must still match per-iteration."""
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+              "verbose": -1, "output_freq": 10}
+    (m_blk, v_blk), (m_it, v_it) = _train_pair(params, 20,
+                                               categorical=True)
+    assert m_blk.count("Tree=") == m_it.count("Tree=")
+    np.testing.assert_allclose(v_blk, v_it, atol=1e-5)
+
+
+def test_early_stopping_stays_on_block_path():
+    """Valid + early_stopping_rounds rides the engine fast path: the
+    booster keeps _can_block() True, stops early, and records
+    best_iteration/best_score from the window evals."""
+    X, y = _data(0)
+    Xv, yv = _data(1, n=1500)
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 31,
+              "verbose": -1, "output_freq": 2}
+    ds = lgb.Dataset(X, label=y, params=params)
+    vs = lgb.Dataset(Xv, label=yv, reference=ds)
+    bst = lgb.train(params, ds, 300, valid_sets=[vs], valid_names=["v0"],
+                    early_stopping_rounds=6, verbose_eval=False,
+                    keep_training_booster=True)
+    g = bst._gbdt
+    assert g._can_block()
+    assert bst.best_iteration > 0
+    assert bst.current_iteration < 300     # actually stopped early
+    assert "v0" in bst.best_score and "auc" in bst.best_score["v0"]
+    # best_score matches a recomputed eval at the recorded scores
+    assert 0.5 < bst.best_score["v0"]["auc"] <= 1.0
+
+
+def test_es_best_iteration_without_trigger():
+    """When the stall window never elapses, best_iteration still reports
+    the best seen (the callback raises at the final iteration with the
+    best recorded, callback.py:113-117)."""
+    X, y = _data(0)
+    Xv, yv = _data(1, n=1500)
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 15,
+              "verbose": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    vs = lgb.Dataset(Xv, label=yv, reference=ds)
+    bst = lgb.train(params, ds, 8, valid_sets=[vs], valid_names=["v0"],
+                    early_stopping_rounds=500, verbose_eval=False,
+                    keep_training_booster=True)
+    assert bst.current_iteration == 8          # never stopped
+    assert 0 < bst.best_iteration <= 8
+    assert "v0" in bst.best_score
+
+
+def test_es_without_valid_raises():
+    """early_stopping_rounds with no valid set fails fast like the
+    callback path, instead of silently training the full budget."""
+    import pytest
+    X, y = _data(0, n=500)
+    with pytest.raises(ValueError, match="validation set"):
+        lgb.train({"objective": "binary", "verbose": -1},
+                  lgb.Dataset(X, label=y), 50, early_stopping_rounds=5,
+                  verbose_eval=False)
+
+
+def test_es_with_output_freq_zero():
+    """output_freq=0 silences printing but must NOT disable early
+    stopping (the reference evaluates every iteration and prints every
+    output_freq)."""
+    X, y = _data(0)
+    Xv, yv = _data(1, n=1500)
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 31,
+              "verbose": -1, "output_freq": 0}
+    ds = lgb.Dataset(X, label=y, params=params)
+    vs = lgb.Dataset(Xv, label=yv, reference=ds)
+    bst = lgb.train(params, ds, 300, valid_sets=[vs],
+                    early_stopping_rounds=6, verbose_eval=False,
+                    keep_training_booster=True)
+    assert bst.current_iteration < 300
+    assert bst.best_iteration > 0

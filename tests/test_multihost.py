@@ -86,3 +86,35 @@ def test_cli_distributed_parallel_learning_example(learner, tmp_path):
         assert p.returncode == 0, f"rank {r} failed:\n{out[-3000:]}"
         assert f"CLI_MULTIHOST_OK rank={r}" in out, out[-2000:]
     assert "CLI_MULTIHOST_AUC=" in outs[0]
+
+
+ES_WORKER = os.path.join(os.path.dirname(__file__),
+                         "multihost_es_worker.py")
+
+
+def test_two_process_early_stopping_rank_identical(tmp_path):
+    """Every rank must take the SAME early-stopping decision (r4 weak
+    #3): GBDT.train adopts rank 0's metric values before deciding, so
+    local-shard metric noise / float ties cannot make ranks diverge
+    (which would deadlock the training collectives)."""
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, ES_WORKER, str(r), str(port), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"ES_SYNC_OK rank={r}" in out, out
