@@ -185,13 +185,22 @@ class GBDT:
                         # cross-process training: this process's local
                         # rows become one padded block of the global
                         # row-sharded arrays (reference mod-rank
-                        # sharding, dataset_loader.cpp:639-742)
-                        if self.boosting_name != "gbdt":
+                        # sharding, dataset_loader.cpp:639-742).
+                        # gbdt/goss/rf compose with it (GOSS samples on
+                        # device from global gradients; RF's baseline
+                        # scores globalize like the live scores); DART
+                        # is the documented descope — its drop
+                        # bookkeeping replays per-tree predictions
+                        # through host-addressable scores (README
+                        # "Multi-process training")
+                        if self.boosting_name == "dart":
                             raise NotImplementedError(
-                                f"boosting={self.boosting_name} is not "
-                                "supported with multi-process training "
-                                "(its per-iteration host flow assumes "
-                                "addressable scores); use boosting=gbdt")
+                                "boosting=dart is not supported with "
+                                "multi-process training (documented "
+                                "descope: per-tree drop/renormalize "
+                                "score patching assumes addressable "
+                                "scores); use gbdt/goss/rf, or "
+                                "single-process multi-device meshes")
                         self._pr = ProcessRows(self.mesh_ctx, n)
                         n = self.num_data = self._pr.n_pad
                     else:
@@ -374,6 +383,12 @@ class GBDT:
         import os as _os
         self._sync_freq = int(_os.environ.get("LGBM_TPU_SYNC_FREQ",
                                               default_sync))
+        # iterations per fused scan dispatch: one dispatch must finish
+        # inside the device watchdog, and at big shapes (255 bins x 136
+        # features x 2.3M rows) 32 chained iterations exceed it — set
+        # LGBM_TPU_BLOCK_CAP=8 to keep each dispatch under ~10 s there
+        self._block_cap = max(1, int(_os.environ.get("LGBM_TPU_BLOCK_CAP",
+                                                     self._BLOCK_CAP)))
 
     def _setup_metrics(self) -> None:
         c = self.config
@@ -593,11 +608,20 @@ class GBDT:
         if self.mesh_ctx is not None:
             n = self.num_data
             if self._pr is not None:
+                pr = self._pr
+                if isinstance(bag, jnp.ndarray) and not getattr(
+                        bag, "is_fully_addressable", True):
+                    # the mask is ALREADY a global row-sharded device
+                    # array (multi-process GOSS derives it from global
+                    # gradients on device; padding rows pre-masked)
+                    if fmask is not None:
+                        fmask = pr.replicate(np.asarray(fmask))
+                    return self._jit_build(self.device_data, grad, hess,
+                                           bag, fmask)
                 # cross-process: the bagging mask is a pure function of
                 # (seed, iteration) so every rank computes the identical
                 # full [n_pad] mask; each contributes its block, with
                 # its per-block padding rows masked out-of-bag
-                pr = self._pr
                 mask = pr.valid_mask_local()
                 if bag is not None:
                     full = np.asarray(bag)
@@ -713,7 +737,20 @@ class GBDT:
                 "num_bins": dd.num_bins}
 
     def _predict_host_tree_binned(self, tree: Tree, dd: DeviceData) -> jnp.ndarray:
-        st = stack_trees([tree], max_bins=dd.max_bins,
+        return self._predict_host_trees_binned([tree], dd)
+
+    def _predict_host_trees_binned(self, trees: List[Tree],
+                                   dd: DeviceData) -> jnp.ndarray:
+        """SUMMED per-row output of ``trees`` in one stacked dispatch
+        (predict_binned accumulates over the stacked tree axis) — the
+        batched form DART's drop/renormalize pass relies on.  The tree
+        axis pads to a power of two with zero stumps: DART's drop count
+        varies every iteration and an unpadded stack would compile one
+        program per distinct count."""
+        if len(trees) > 1:
+            pad = (1 << (len(trees) - 1).bit_length()) - len(trees)
+            trees = list(trees) + [Tree(2)] * pad   # stumps: 0 output
+        st = stack_trees(trees, max_bins=dd.max_bins,
                          pad_leaves=self.growth.num_leaves
                          if self.train_set is not None else 0)
         pred = predict_binned(st, dd.bins, dd.nan_bins, dd.default_bins,
@@ -1112,7 +1149,7 @@ class GBDT:
                     return True
                 done += 1
                 continue
-            nb = min(num_iters - done, self._BLOCK_CAP)
+            nb = min(num_iters - done, self._block_cap)
             fn = self._block_fn(self._pick_block_len(nb))
             with tag("block") as tdone:
                 (self.scores, vscores), trees = self._dispatch_retry(

@@ -20,6 +20,8 @@ fusion into the jitted boosting step.  Interface parity:
 """
 from __future__ import annotations
 
+import functools
+import os
 from typing import Optional, Tuple
 
 import jax
@@ -523,78 +525,145 @@ class LambdarankNDCG(ObjectiveFunction):
         if self.query_boundaries is None:
             raise ValueError("lambdarank requires query data")
         qb = self.query_boundaries
-        sizes = qb[1:] - qb[:-1]
+        sizes = (qb[1:] - qb[:-1]).astype(np.int64)
         self.max_query = int(sizes.max())
-        nq = len(sizes)
-        # pad queries to [nq, M]: doc index matrix + validity mask
-        M = self.max_query
-        idx = qb[:-1, None] + np.arange(M)[None, :]
-        valid = np.arange(M)[None, :] < sizes[:, None]
-        idx = np.where(valid, idx, 0)
-        self.q_idx = jnp.asarray(idx, jnp.int32)
-        self.q_valid = jnp.asarray(valid)
         labels = self._label_np
-        lab = np.where(valid, labels[idx], -1)
-        # inverse max DCG per query at truncation max_position
-        # (rank_objective.hpp Init :46-73)
-        inv_max_dcg = np.zeros(nq)
-        discounts = 1.0 / np.log2(np.arange(M) + 2.0)
-        trunc = min(self.max_position, M)
-        for q in range(nq):
-            l = np.sort(lab[q][valid[q]])[::-1][:trunc]
-            dcg = np.sum(self.label_gain[l.astype(int)] * discounts[:len(l)])
-            inv_max_dcg[q] = 1.0 / dcg if dcg > 0 else 0.0
-        self.inv_max_dcg = jnp.asarray(inv_max_dcg, jnp.float32)
-        self.q_label_gain = jnp.asarray(
-            np.where(valid, self.label_gain[lab.astype(int) * (lab >= 0)], 0.0),
-            jnp.float32)
-        self.q_label = jnp.asarray(np.where(valid, lab, -1), jnp.float32)
-        self.discounts = jnp.asarray(discounts, jnp.float32)
-        self.trunc = trunc
+
+        # Queries BUCKETED by ceil-pow2 size: padding every query to the
+        # global max wastes ~10x at MSLR shape (mean ~120 docs, max
+        # ~1.2k), and the r4 [nq, M, M] full pair grid was out of
+        # memory by orders of magnitude there (VERDICT r5 #2).  Within
+        # a bucket the pair grid is [T, M]: rows = the top-T
+        # score-sorted positions (T = truncation), cols = all sorted
+        # positions, pairs r < c — exactly the reference's loop
+        # structure (rank_objective.hpp:75-81: `for i < truncation_level;
+        # for j = i+1`), so pair count is O(T * docs), not O(docs^2).
+        by_size: dict = {}
+        for q, s in enumerate(sizes):
+            Mb = 1 << max(4, int(s - 1).bit_length())
+            by_size.setdefault(Mb, []).append(q)
+        self.discounts = jnp.asarray(
+            1.0 / np.log2(np.arange(max(by_size) + 1) + 2.0), jnp.float32)
+        self.buckets = []
+        for Mb in sorted(by_size):
+            qs = np.asarray(by_size[Mb], np.int64)
+            T = min(self.max_position, Mb)
+            idx = qb[:-1][qs, None] + np.arange(Mb)[None, :]
+            valid = np.arange(Mb)[None, :] < sizes[qs, None]
+            idx = np.where(valid, idx, 0)
+            lab = np.where(valid, labels[idx.astype(np.int64)], -1)
+            gain = np.where(valid,
+                            self.label_gain[lab.astype(int) * (lab >= 0)],
+                            0.0)
+            # inverse max DCG at truncation (rank_objective.hpp:46-73),
+            # bucket-vectorized (a per-query python loop took minutes
+            # at 30k queries)
+            disc = 1.0 / np.log2(np.arange(T) + 2.0)
+            top = -np.sort(-np.where(valid, lab, -1), axis=1)[:, :T]
+            ideal = np.where(top >= 0,
+                             self.label_gain[top.astype(int) * (top >= 0)],
+                             0.0)
+            dcg = (ideal * disc[None, :]).sum(axis=1)
+            imd = np.where(dcg > 0, 1.0 / np.maximum(dcg, 1e-300), 0.0)
+            self.buckets.append({
+                "M": Mb, "T": T,
+                "idx": jnp.asarray(idx, jnp.int32),
+                "valid": jnp.asarray(valid),
+                "label": jnp.asarray(np.where(valid, lab, -1), jnp.float32),
+                "gain": jnp.asarray(gain, jnp.float32),
+                "imd": jnp.asarray(imd, jnp.float32),
+            })
 
     def get_gradients(self, score):
-        """Pairwise NDCG-delta-weighted lambdas, vectorized per query block
-        (the reference loops docs i>j per query with OpenMP; here the full
-        [M, M] pair grid per query is computed by vmap — padded/masked)."""
-        M = self.max_query
-
-        def per_query(idx, valid, label, gain, inv_max_dcg):
-            s = score[idx]
-            s = jnp.where(valid, s, -jnp.inf)
-            # rank of each doc by score desc (for the DCG discount)
-            order = jnp.argsort(-s)
-            rank = jnp.argsort(order)
-            disc = self.discounts[jnp.minimum(rank, M - 1)]
-            within_trunc = rank < self.trunc
-            # pair grids
-            dl = label[:, None] - label[None, :]            # label diff
-            better = dl > 0
-            sd = s[:, None] - s[None, :]
-            pair_valid = (valid[:, None] & valid[None, :] & better
-                          & (within_trunc[:, None] | within_trunc[None, :]))
-            # |delta NDCG| of swapping i, j
-            dgain = gain[:, None] - gain[None, :]
-            ddisc = disc[:, None] - disc[None, :]
-            delta = jnp.abs(dgain * ddisc) * inv_max_dcg
-            sig = jax.nn.sigmoid(-self.sigmoid * sd)        # p(i worse than j)
-            lam = -self.sigmoid * sig * delta
-            h = self.sigmoid * self.sigmoid * sig * (1 - sig) * delta
-            lam = jnp.where(pair_valid, lam, 0.0)
-            h = jnp.where(pair_valid, h, 0.0)
-            g_doc = jnp.sum(lam, axis=1) - jnp.sum(lam, axis=0)
-            h_doc = jnp.sum(h, axis=1) + jnp.sum(h, axis=0)
-            return g_doc, h_doc
-
-        g_q, h_q = jax.vmap(per_query)(self.q_idx, self.q_valid, self.q_label,
-                                       self.q_label_gain, self.inv_max_dcg)
-        grad = jnp.zeros_like(score).at[self.q_idx.ravel()].add(
-            jnp.where(self.q_valid.ravel(), g_q.ravel(), 0.0))
-        hess = jnp.zeros_like(score).at[self.q_idx.ravel()].add(
-            jnp.where(self.q_valid.ravel(), h_q.ravel(), 0.0))
+        """Pairwise NDCG-delta-weighted lambdas over the bucketed
+        [T, M] sorted-position pair grids (see ``init``).  Traceable —
+        runs inside the fused training block."""
+        grad = jnp.zeros_like(score)
+        hess = jnp.zeros_like(score)
+        # pair-grid entries per dispatched chunk: bounds the [C, T, M]
+        # intermediates (~10 live f32 arrays) to a few hundred MB of HBM
+        budget = int(os.environ.get("LGBM_TPU_RANK_CHUNK_PAIRS", 8_000_000))
+        for bk in self.buckets:
+            Mb, T = bk["M"], bk["T"]
+            nq = bk["idx"].shape[0]
+            C = max(1, min(nq, budget // max(1, T * Mb)))
+            g, h = _lambdarank_bucket_grads(
+                score[bk["idx"]], bk["valid"], bk["label"], bk["gain"],
+                bk["imd"], self.discounts[:Mb],
+                jnp.float32(self.sigmoid), T=T, C=C)
+            grad = grad.at[bk["idx"].ravel()].add(
+                jnp.where(bk["valid"], g, 0.0).ravel())
+            hess = hess.at[bk["idx"].ravel()].add(
+                jnp.where(bk["valid"], h, 0.0).ravel())
         return grad, hess
 
     def to_string(self):
         return "lambdarank"
+
+
+@functools.partial(jax.jit, static_argnames=("T", "C"))
+def _lambdarank_bucket_grads(s, valid, label, gain, imd, disc, sigma,
+                             *, T: int, C: int):
+    """(grad, hess) per padded doc slot for one query-size bucket.
+
+    Per query (vmapped, ``lax.map``-chunked by ``C`` queries): sort docs
+    by score desc, then the pair grid is ``[T, M]`` over SORTED
+    positions — rows the top-T positions, cols all positions, a pair
+    live when ``col > row``, both valid, labels differ.  Since row <
+    col, "min position < truncation" (the reference's pair condition,
+    rank_objective.hpp:75-81) is exactly "row < T".  Each unordered
+    pair appears once; the better-labeled side receives ``lam``, the
+    worse ``-lam``, both receive ``+hess`` — summed along grid axes and
+    scattered back through the sort permutation.
+    """
+    nq, M = s.shape
+
+    def per_query(args):
+        s, valid, label, gain, imd = args
+        sm = jnp.where(valid, s, -jnp.inf)
+        order = jnp.argsort(-sm)
+        s_s = sm[order]
+        lab_s = label[order]
+        gain_s = gain[order]
+        val_s = valid[order]
+        dl = lab_s[:T, None] - lab_s[None, :]
+        pv = ((jnp.arange(M)[None, :] > jnp.arange(T)[:, None])
+              & val_s[None, :] & val_s[:T, None] & (dl != 0))
+        delta = jnp.abs((gain_s[:T, None] - gain_s[None, :])
+                        * (disc[:T, None] - disc[None, :])) * imd
+        better_row = dl > 0
+        sd = s_s[:T, None] - s_s[None, :]
+        sig = jax.nn.sigmoid(-sigma * jnp.where(better_row, sd, -sd))
+        lam = jnp.where(pv, -sigma * sig * delta, 0.0)
+        hh = jnp.where(pv, sigma * sigma * sig * (1.0 - sig) * delta, 0.0)
+        row_sign = jnp.where(better_row, 1.0, -1.0)
+        signed = lam * row_sign
+        g = (jnp.zeros(M).at[order[:T]].add(jnp.sum(signed, axis=1))
+             .at[order].add(-jnp.sum(signed, axis=0)))
+        h = (jnp.zeros(M).at[order[:T]].add(jnp.sum(hh, axis=1))
+             .at[order].add(jnp.sum(hh, axis=0)))
+        return g, h
+
+    if C >= nq:
+        return jax.vmap(per_query)((s, valid, label, gain, imd))
+    # chunk the query axis: [ceil(nq/C), C, ...] with dummy (all-invalid)
+    # pad queries, sequenced by lax.map so only one [C, T, M] grid set
+    # is live at a time
+    NC = -(-nq // C)
+    pad = NC * C - nq
+
+    def padq(a, fill):
+        if pad == 0:
+            return a.reshape((NC, C) + a.shape[1:])
+        return jnp.concatenate(
+            [a, jnp.full((pad,) + a.shape[1:], fill, a.dtype)]
+        ).reshape((NC, C) + a.shape[1:])
+
+    g, h = jax.lax.map(
+        jax.vmap(per_query),
+        (padq(s, 0.0), padq(valid, False), padq(label, -1.0),
+         padq(gain, 0.0), padq(imd, 0.0)))
+    return (g.reshape(NC * C, M)[:nq], h.reshape(NC * C, M)[:nq])
 
 
 class CustomObjective(ObjectiveFunction):

@@ -1,0 +1,122 @@
+"""Shape-bounded lambdarank gradients (VERDICT r5 #2).
+
+The r4 implementation padded every query to the global max and built
+``[nq, M, M]`` pair grids — out of memory by orders of magnitude at
+MSLR shape (~19k queries, queries up to ~1.2k docs).  The rewrite
+buckets queries by ceil-pow2 size and computes ``[T, M]`` sorted-
+position pair grids (rows = top-T positions, cols = all, pairs r < c),
+mirroring the reference's truncation-bounded loop
+(`rank_objective.hpp:75-81`).  These tests pin the grids to a
+brute-force all-pairs oracle and exercise mixed query sizes across
+buckets and chunked dispatch.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import Metadata
+from lightgbm_tpu.objective.objectives import LambdarankNDCG
+
+
+def _brute_force(scores, labels, qb, sigma, trunc, label_gain):
+    """All-pairs oracle with the reference pair condition: labels
+    differ, both valid, and at least one of the pair ranked (by score,
+    desc) within the truncation level."""
+    n = len(scores)
+    grad = np.zeros(n)
+    hess = np.zeros(n)
+    for q in range(len(qb) - 1):
+        lo, hi = qb[q], qb[q + 1]
+        s = scores[lo:hi].astype(np.float64)
+        lab = labels[lo:hi].astype(int)
+        m = hi - lo
+        order = np.argsort(-s, kind="mergesort")
+        rank = np.argsort(order)
+        disc = 1.0 / np.log2(rank + 2.0)
+        gain = label_gain[lab]
+        t = min(trunc, m)
+        ideal = np.sort(label_gain[lab])[::-1][:t]
+        maxdcg = np.sum(ideal / np.log2(np.arange(len(ideal)) + 2.0))
+        imd = 1.0 / maxdcg if maxdcg > 0 else 0.0
+        for i in range(m):
+            for j in range(m):
+                if lab[i] <= lab[j]:
+                    continue                      # i must be better
+                if rank[i] >= t and rank[j] >= t:
+                    continue                      # neither in truncation
+                delta = abs((gain[i] - gain[j]) * (disc[i] - disc[j])) * imd
+                sig = 1.0 / (1.0 + np.exp(sigma * (s[i] - s[j])))
+                lam = -sigma * sig * delta
+                h = sigma * sigma * sig * (1 - sig) * delta
+                grad[lo + i] += lam
+                grad[lo + j] -= lam
+                hess[lo + i] += h
+                hess[lo + j] += h
+    return grad, hess
+
+
+def _make_obj(labels, qb, params=None):
+    cfg = Config.from_params({"objective": "lambdarank", **(params or {})})
+    obj = LambdarankNDCG(cfg)
+    md = Metadata(label=labels.astype(np.float32),
+                  query_boundaries=np.asarray(qb, np.int64))
+    obj.init(md, len(labels))
+    return obj
+
+
+@pytest.mark.parametrize("sizes", [
+    [20, 20, 20],                      # single bucket
+    [3, 17, 40, 90, 250, 7, 130],      # many buckets, mixed sizes
+])
+def test_bucketed_grads_match_brute_force(sizes):
+    rng = np.random.RandomState(0)
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = qb[-1]
+    labels = rng.randint(0, 5, size=n)
+    scores = rng.normal(size=n).astype(np.float32)
+    obj = _make_obj(labels, qb)
+    g, h = obj.get_gradients(scores)
+    gain = np.asarray([float((1 << i) - 1) for i in range(31)])
+    g_ref, h_ref = _brute_force(scores, labels, qb, sigma=obj.sigmoid,
+                                trunc=obj.max_position, label_gain=gain)
+    np.testing.assert_allclose(np.asarray(g), g_ref, rtol=2e-4, atol=3e-6)
+    np.testing.assert_allclose(np.asarray(h), h_ref, rtol=2e-4, atol=3e-6)
+
+
+def test_bucketed_grads_chunked_dispatch(monkeypatch):
+    """A tiny chunk budget forces the lax.map path; results must not
+    change."""
+    rng = np.random.RandomState(1)
+    sizes = [33] * 40
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = qb[-1]
+    labels = rng.randint(0, 5, size=n)
+    scores = rng.normal(size=n).astype(np.float32)
+    g0, h0 = _make_obj(labels, qb).get_gradients(scores)
+    monkeypatch.setenv("LGBM_TPU_RANK_CHUNK_PAIRS", "2000")
+    g1, h1 = _make_obj(labels, qb).get_gradients(scores)
+    np.testing.assert_allclose(np.asarray(g0), np.asarray(g1),
+                               rtol=1e-5, atol=1e-7)
+    np.testing.assert_allclose(np.asarray(h0), np.asarray(h1),
+                               rtol=1e-5, atol=1e-7)
+
+
+def test_lambdarank_trains_on_block_path():
+    """lambdarank's gradients are traceable -> the fused block path
+    applies; NDCG improves over training."""
+    rng = np.random.RandomState(13)
+    sizes = rng.randint(5, 60, size=80)
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = qb[-1]
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    rel = np.clip((X[:, 0] + 0.4 * rng.normal(size=n)) * 1.3 + 1.5,
+                  0, 4).astype(np.float32)
+    train = lgb.Dataset(X, label=rel, group=np.asarray(sizes))
+    bst = lgb.train({"objective": "lambdarank", "metric": "ndcg",
+                     "ndcg_eval_at": [10], "num_leaves": 31,
+                     "min_data_in_leaf": 5, "verbose": -1}, train, 30,
+                    verbose_eval=False, keep_training_booster=True)
+    assert bst._gbdt._can_block()
+    res = bst._gbdt.eval_train()
+    assert any(v > 0.8 for _, _, v, _ in res)

@@ -118,3 +118,36 @@ def test_two_process_early_stopping_rank_identical(tmp_path):
     for r, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"rank {r} failed:\n{out}"
         assert f"ES_SYNC_OK rank={r}" in out, out
+
+
+VARIANTS_WORKER = os.path.join(os.path.dirname(__file__),
+                               "multihost_variants_worker.py")
+
+
+def test_two_process_boosting_variants(tmp_path):
+    """GOSS under 2-process data-parallel builds the SAME model as a
+    serial run on the same file (original-row-order device sampling);
+    RF trains rank-identically; DART refuses with a documented error
+    (VERDICT r5 #6; reference boosting.cpp:30-63 runs variants under
+    every parallel learner)."""
+    port = _free_port()
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": repo + os.pathsep + os.environ.get("PYTHONPATH", "")}
+    env.pop("XLA_FLAGS", None)
+    procs = [subprocess.Popen(
+        [sys.executable, VARIANTS_WORKER, str(r), str(port), str(tmp_path)],
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        env=env) for r in range(2)]
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for r, (p, out) in enumerate(zip(procs, outs)):
+        assert p.returncode == 0, f"rank {r} failed:\n{out}"
+        assert f"VARIANTS_OK rank={r}" in out, out
