@@ -84,7 +84,7 @@ def real_data_eval():
     g = bst._gbdt
     t0 = time.time()
     g.train_block(iters)
-    jax.block_until_ready(g.scores)
+    _sync(g.scores)
     warm = time.time() - t0
     return {"real_data": name, "real_data_iters": iters,
             "real_data_eval_auc": round(auc, 5),
@@ -112,10 +112,10 @@ def synthetic_leg(n, iters, leaves, max_bin, f=28, seed=0):
     # warmup: compiles the block program + runs one full pass
     bst.update()
     bst._gbdt.train_block(iters)
-    jax.block_until_ready(bst._gbdt.scores)
+    _sync(bst._gbdt.scores)
     t0 = time.time()
     bst._gbdt.train_block(iters)
-    jax.block_until_ready(bst._gbdt.scores)
+    _sync(bst._gbdt.scores)
     wall = time.time() - t0
 
     # accuracy gate (VERDICT r1 #6): the timed model must actually
@@ -130,6 +130,64 @@ def synthetic_leg(n, iters, leaves, max_bin, f=28, seed=0):
     import gc
     gc.collect()
     return n * iters / wall, auc
+
+
+def _sync(x):
+    """Force a REAL device sync: fetch one scalar to host.  On tunneled
+    TPU runtimes ``jax.block_until_ready`` can return before execution
+    finishes (measured locally: 10 dispatches 'ready' in 0.35 ms);
+    a device->host scalar read cannot."""
+    import numpy as np
+    return np.asarray(x.ravel()[0])
+
+
+def valid_leg(leaves, max_bin, f=28):
+    """Train WITH a validation set + early stopping attached — the
+    standard workflow — and report warm throughput.  VERDICT r4 #1's
+    acceptance: this must stay on the fused block path, within ~20% of
+    the no-valid leg's s/iter (the reference scores validation data
+    without decelerating training, gbdt.cpp:492+)."""
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.basic import Booster
+    n = int(os.environ.get("BENCH_VALID_ROWS", 1_000_000))
+    nv = n // 5
+    iters = int(os.environ.get("BENCH_VALID_ITERS", 64))
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(n + nv, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] - X[:, 2]
+         + rng.normal(scale=1.0, size=n + nv) > 0).astype(np.float32)
+    params = {"objective": "binary", "metric": "auc",
+              "num_leaves": leaves, "max_bin": max_bin,
+              "learning_rate": 0.1, "min_data_in_leaf": 20,
+              "verbose": -1}
+    ds = lgb.Dataset(X[:n], label=y[:n], params=params)
+    vs = lgb.Dataset(X[n:], label=y[n:], reference=ds)
+    ds.construct()
+    del X
+    # early_stopping_round high enough that the timed window never
+    # stops: the leg times the with-valid machinery, not a short run
+    bst = lgb.train(dict(params, early_stopping_round=10_000), ds,
+                    num_boost_round=iters, valid_sets=[vs],
+                    verbose_eval=False)
+    g = bst._gbdt
+    # warm the timed window's own block length (train()'s eval windows
+    # may have compiled different block sizes)
+    g.train_block(iters)
+    _sync(g.scores)
+    t0 = time.time()
+    g.train_block(iters)
+    _sync(g.scores)
+    wall = time.time() - t0
+    auc = float(_auc(y[n:], np.asarray(g._valid_scores[0][:, 0])))
+    del bst, ds, vs
+    import gc
+    gc.collect()
+    return {"valid_train_rows": n, "valid_rows": nv,
+            "valid_iters": iters,
+            "valid_row_iters_per_sec": round(n * iters / wall, 1),
+            "valid_eval_auc": round(auc, 5),
+            "valid_on_block_path": bool(g._can_block())}
 
 
 REFERENCE_MSLR_DOC_ITERS_PER_SEC = 2_270_296 * 500 / 215.320316
@@ -187,10 +245,10 @@ def ranking_leg():
     g = bst._gbdt
     bst.update()                    # compiles block + objective buckets
     g.train_block(iters)
-    jax.block_until_ready(g.scores)
+    _sync(g.scores)
     t0 = time.time()
     g.train_block(iters)
-    jax.block_until_ready(g.scores)
+    _sync(g.scores)
     wall = time.time() - t0
     m = NDCGMetric(Config.from_params(params))
     qb = np.concatenate([[0], np.cumsum(sizes)])
@@ -208,7 +266,10 @@ def ranking_leg():
 
 def main():
     n = int(os.environ.get("BENCH_ROWS", 1_000_000))
-    iters = int(os.environ.get("BENCH_ITERS", 64))
+    # 128 (not 64): the timed window carries ONE end-of-window device
+    # sync whose round-trip is ~0.1 s on tunneled runtimes — at 64
+    # iterations that tax alone is ~5% of the leg (VERDICT r4 weak #1)
+    iters = int(os.environ.get("BENCH_ITERS", 128))
     leaves = int(os.environ.get("BENCH_LEAVES", 255))
     max_bin = int(os.environ.get("BENCH_BIN", 63))
 
@@ -235,7 +296,12 @@ def main():
 
     if os.environ.get("BENCH_FULL", "1") != "0":
         n_full = int(os.environ.get("BENCH_FULL_ROWS", 10_500_000))
-        it_full = int(os.environ.get("BENCH_FULL_ITERS", 128))
+        # 500 = the reference's actual HIGGS iteration count
+        # (docs/Experiments.rst:104-116); with a 32-iteration block cap
+        # this is 15 full blocks + a 20-iteration residue, so residue
+        # compile + masked-iteration effects are inside the timed pass
+        # (VERDICT r4 #3)
+        it_full = int(os.environ.get("BENCH_FULL_ITERS", 500))
         try:
             rps_f, auc_f = synthetic_leg(n_full, it_full, leaves, max_bin,
                                          seed=1)
@@ -252,6 +318,54 @@ def main():
             vs = min(vs, rps_f / REFERENCE_ROW_ITERS_PER_SEC)
         except Exception as exc:     # the headline must then say so
             line["full_leg"] = f"failed: {exc}"
+            auc_ok = False
+
+    # with-valid leg (VERDICT r4 #1): the standard train+valid+early-stop
+    # workflow must stay on the fused block path, within ~20% of the
+    # no-valid leg's per-iteration cost
+    if os.environ.get("BENCH_VALID", "1") != "0":
+        try:
+            vleg = valid_leg(leaves, max_bin)
+            vleg["valid_block_ok"] = bool(vleg["valid_on_block_path"])
+            # the slowdown gate only means something when the no-valid
+            # leg ran the SAME train-row count (shape differences would
+            # otherwise masquerade as with-valid overhead)
+            if n == vleg["valid_train_rows"]:
+                ratio = rps / max(vleg["valid_row_iters_per_sec"], 1e-9)
+                vleg["valid_slowdown_vs_novalid"] = round(ratio, 4)
+                vleg["valid_block_ok"] = bool(
+                    vleg["valid_block_ok"] and ratio <= 1.25)
+            line.update(vleg)
+            if not vleg["valid_block_ok"]:
+                auc_ok = False
+        except Exception as exc:
+            line["valid_leg"] = f"failed: {exc}"
+            auc_ok = False
+
+    # 255-bin leg (VERDICT r4 #7): the EXACT docs/Experiments.rst:104-116
+    # bin/leaf config (max_bin=255, 255 leaves) at reduced iterations, so
+    # the CPU comparison has an apples-to-apples anchor (the 238.5 s CPU
+    # run was recorded at 255 bins; the 63-bin default above follows the
+    # reference GPU docs' own recommendation).  255 is also the boundary
+    # of the Pallas one-hot kernel's bin range — worth pinning.
+    if os.environ.get("BENCH_255", "1") != "0":
+        n255 = int(os.environ.get("BENCH_255_ROWS", 1_000_000))
+        it255 = int(os.environ.get("BENCH_255_ITERS", 32))
+        try:
+            rps_255, auc_255 = synthetic_leg(n255, it255, leaves, 255,
+                                             seed=2)
+            auc_255_ok = bool(auc_255 >= 0.85)
+            line.update({
+                "bin255_rows": n255, "bin255_iters": it255,
+                "bin255_row_iters_per_sec": round(rps_255, 1),
+                "bin255_train_auc": round(auc_255, 5),
+                "bin255_auc_ok": auc_255_ok,
+                "bin255_vs_baseline": round(
+                    rps_255 / REFERENCE_ROW_ITERS_PER_SEC, 4),
+            })
+            auc_ok = auc_ok and auc_255_ok
+        except Exception as exc:
+            line["bin255_leg"] = f"failed: {exc}"
             auc_ok = False
 
     # ranking leg: its own baseline (MS LTR) and its own NDCG gate —

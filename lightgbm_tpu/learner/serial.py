@@ -382,12 +382,26 @@ def rescan_changed(data: DeviceData, params: GrowthParams, feature_mask,
                              data.feat_group, data.feat_offset,
                              data.num_bins, data.default_bins,
                              bin_stride(data.max_bins))
-    res = find_best_splits(grid, lsg[safe], lsh[safe], lc[safe],
-                           data.num_bins, data.missing_types,
-                           data.default_bins, data.is_categorical,
-                           params.split, feature_mask,
-                           any_categorical=data.has_categorical,
-                           any_missing=data.has_missing)
+    B = grid.shape[2]
+    from ..ops.pallas_split import find_best_splits_pallas, split_kernel_ok
+    interp = _os_env.environ.get("LGBM_TPU_SPLIT_INTERPRET") == "1"
+    if (split_kernel_ok(grid.shape[1], B, data.has_categorical,
+                        num_rows=data.bins.shape[0])
+            and (interp or jax.default_backend() == "tpu")):
+        # fused split scan: one Pallas call replaces ~50 small XLA ops
+        # per wave (the row-independent per-iteration tax, VERDICT r4 #4)
+        res = find_best_splits_pallas(
+            grid, lsg[safe], lsh[safe], lc[safe], data.num_bins,
+            data.missing_types, data.default_bins, B=B,
+            params=params.split, feature_mask=feature_mask,
+            any_missing=data.has_missing, interpret=interp)
+    else:
+        res = find_best_splits(grid, lsg[safe], lsh[safe], lc[safe],
+                               data.num_bins, data.missing_types,
+                               data.default_bins, data.is_categorical,
+                               params.split, feature_mask,
+                               any_categorical=data.has_categorical,
+                               any_missing=data.has_missing)
     return hist_state, ids, res
 
 

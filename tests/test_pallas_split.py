@@ -1,0 +1,163 @@
+"""Fused Pallas split-finder vs the XLA split scan (oracle tests).
+
+The kernel (`ops/pallas_split.py`) must reproduce
+`ops/split.py:find_best_splits`'s numerical path decision-for-decision:
+same best (feature, threshold, missing-direction) per leaf and matching
+sums/gains (prefix-sum association differs in the last ulp, so float
+fields are compared at ~1e-5 relative; decisions on non-degenerate
+random gains are compared exactly).  Runs in interpret mode on the CPU
+test mesh.
+"""
+import os
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from lightgbm_tpu.io.binning import (MISSING_NAN, MISSING_NONE,
+                                     MISSING_ZERO)
+from lightgbm_tpu.ops.pallas_split import (find_best_splits_pallas,
+                                           split_kernel_ok)
+from lightgbm_tpu.ops.split import SplitParams, find_best_splits
+
+
+def _consistent_hist(seed, L2, F, B, n_rows=4000, missing=True):
+    """Histograms accumulated from simulated rows, so that per-feature
+    bin sums agree with the leaf totals (every feature partitions the
+    same rows)."""
+    rng = np.random.RandomState(seed)
+    num_bins = rng.randint(B // 2, B + 1, size=F).astype(np.int32)
+    if missing:
+        missing_types = rng.choice(
+            [MISSING_NONE, MISSING_NAN, MISSING_ZERO], size=F)
+    else:
+        missing_types = np.full(F, MISSING_NONE)
+    default_bins = np.array(
+        [rng.randint(0, nb) for nb in num_bins], np.int32)
+    leaf = rng.randint(0, L2, size=n_rows)
+    g = rng.normal(size=n_rows).astype(np.float64)
+    h = np.abs(rng.normal(size=n_rows)).astype(np.float64) + 0.1
+    hist = np.zeros((L2, F, B, 3), np.float32)
+    for f in range(F):
+        bins = rng.randint(0, num_bins[f], size=n_rows)
+        np.add.at(hist[:, f, :, 0], (leaf, bins), g)
+        np.add.at(hist[:, f, :, 1], (leaf, bins), h)
+        np.add.at(hist[:, f, :, 2], (leaf, bins), 1.0)
+    lsg = np.zeros(L2); lsh = np.zeros(L2); lc = np.zeros(L2)
+    np.add.at(lsg, leaf, g)
+    np.add.at(lsh, leaf, h)
+    np.add.at(lc, leaf, 1.0)
+    return (jnp.asarray(hist), jnp.asarray(lsg.astype(np.float32)),
+            jnp.asarray(lsh.astype(np.float32)),
+            jnp.asarray(lc.astype(np.float32)),
+            jnp.asarray(num_bins), jnp.asarray(missing_types),
+            jnp.asarray(default_bins))
+
+
+def _compare(seed, L2=14, F=8, B=16, params=SplitParams(min_data_in_leaf=5),
+             missing=True, feature_mask=None):
+    (hist, lsg, lsh, lc, num_bins, missing_types,
+     default_bins) = _consistent_hist(seed, L2, F, B, missing=missing)
+    assert split_kernel_ok(F, B, False)
+    ref = find_best_splits(hist, lsg, lsh, lc, num_bins, missing_types,
+                           default_bins, jnp.zeros(F, bool), params,
+                           feature_mask, any_categorical=False,
+                           any_missing=missing)
+    got = find_best_splits_pallas(
+        hist, lsg, lsh, lc, num_bins, missing_types, default_bins,
+        B=B, params=params, feature_mask=feature_mask,
+        any_missing=missing, interpret=True)
+    has_split = np.asarray(ref.gain) > 0
+    np.testing.assert_array_equal(np.asarray(got.feature)[has_split],
+                                  np.asarray(ref.feature)[has_split])
+    np.testing.assert_array_equal(np.asarray(got.threshold)[has_split],
+                                  np.asarray(ref.threshold)[has_split])
+    np.testing.assert_array_equal(
+        np.asarray(got.default_left)[has_split],
+        np.asarray(ref.default_left)[has_split])
+    np.testing.assert_allclose(np.asarray(got.gain)[has_split],
+                               np.asarray(ref.gain)[has_split],
+                               rtol=2e-4, atol=1e-5)
+    for fld in ("left_sum_grad", "left_sum_hess", "left_count",
+                "right_sum_grad", "right_sum_hess", "right_count",
+                "left_output", "right_output"):
+        np.testing.assert_allclose(
+            np.asarray(getattr(got, fld))[has_split],
+            np.asarray(getattr(ref, fld))[has_split],
+            rtol=2e-4, atol=1e-5, err_msg=fld)
+    # no-split leaves agree on sign (both report gain <= 0)
+    assert ((np.asarray(got.gain) > 0) == has_split).all()
+    return has_split
+
+
+def test_oracle_with_missing():
+    found = 0
+    for seed in range(4):
+        found += _compare(seed).sum()
+    assert found >= 8          # the comparison actually exercised splits
+
+
+def test_oracle_no_missing():
+    found = 0
+    for seed in range(3):
+        found += _compare(seed, missing=False).sum()
+    assert found >= 6
+
+
+def test_oracle_wide_bins():
+    _compare(7, L2=30, F=4, B=64,
+             params=SplitParams(min_data_in_leaf=20,
+                                min_sum_hessian_in_leaf=1.0))
+
+
+def test_oracle_l1_l2():
+    _compare(11, params=SplitParams(min_data_in_leaf=5, lambda_l1=0.5,
+                                    lambda_l2=2.0, min_gain_to_split=0.1))
+
+
+def test_oracle_feature_mask():
+    fm = jnp.asarray(np.array([1, 0, 1, 0, 1, 1, 0, 1], bool))
+    hs = _compare(13, feature_mask=fm)
+    (hist, lsg, lsh, lc, num_bins, missing_types,
+     default_bins) = _consistent_hist(13, 14, 8, 16)
+    got = find_best_splits_pallas(
+        hist, lsg, lsh, lc, num_bins, missing_types, default_bins,
+        B=16, params=SplitParams(min_data_in_leaf=5), feature_mask=fm,
+        any_missing=True, interpret=True)
+    masked_out = ~np.asarray(fm)[np.asarray(got.feature)[hs]]
+    assert not masked_out.any()
+
+
+def test_end_to_end_tree_matches_xla_path():
+    """build_tree with the kernel (interpret mode) == the XLA scan path
+    on a small numerical dataset."""
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.device import to_device
+    from lightgbm_tpu.learner.serial import GrowthParams, build_tree
+    from lightgbm_tpu.ops.split import SplitParams as SP
+
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(3000, 12)).astype(np.float32)
+    X[rng.uniform(size=X.shape) < 0.05] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + 0.5 * np.nan_to_num(X[:, 1])
+         + rng.normal(scale=0.3, size=3000) > 0).astype(np.float32)
+    # max_bin=127 -> stride 128; 12 features x 128 = 1536 lanes (12x128)
+    ds = BinnedDataset.from_raw(X, Config.from_params({"max_bin": 127}))
+    dd = to_device(ds)
+    g = jnp.asarray(1.0 - 2.0 * y)
+    h = jnp.ones(3000)
+    p = GrowthParams(num_leaves=31, split=SP(min_data_in_leaf=10))
+
+    os.environ["LGBM_TPU_SPLIT_INTERPRET"] = "1"
+    try:
+        kt = build_tree(dd, g, h, p, hist_backend="scatter")
+    finally:
+        del os.environ["LGBM_TPU_SPLIT_INTERPRET"]
+    xt = build_tree(dd, g, h, p, hist_backend="scatter")
+    assert int(kt.num_leaves) == int(xt.num_leaves)
+    assert (np.asarray(kt.row_leaf) == np.asarray(xt.row_leaf)).mean() \
+        > 0.999
+    np.testing.assert_allclose(np.asarray(kt.leaf_value),
+                               np.asarray(xt.leaf_value),
+                               rtol=1e-4, atol=1e-6)
