@@ -44,6 +44,16 @@ def test_recorded_parity_table():
     assert d_full <= tol, (
         f"default mode {default} drifted {d_full:.5f} from hi+lo at 500 "
         f"iters (tolerance {tol}); re-examine default_hist_mode()")
+    # full-scale anchor (VERDICT r4 #8): the quantized default's parity
+    # evidence must reach the LARGEST shape the bench runs (10.5M rows
+    # is ~10x the accumulation length of the 1M anchor)
+    n_xl = table["workload"].get("n_xl")
+    if n_xl and (default, n_xl) in results:
+        d_xl = abs(results[(default, n_xl)]["test_auc"]
+                   - results[("hilo", n_xl)]["test_auc"])
+        assert d_xl <= tol, (
+            f"default mode {default} drifted {d_xl:.5f} from hi+lo at "
+            f"{n_xl} rows (tolerance {tol})")
     exact = results[("scatter", n_small)]["test_auc"]
     for mode in (default, "hilo"):
         delta = abs(results[(mode, n_small)]["test_auc"] - exact)

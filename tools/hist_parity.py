@@ -87,7 +87,7 @@ def run_child(mode, n_train):
 
 def save(results):
     table = {
-        "workload": {"n_full": N_FULL, "n_small": N_SMALL,
+        "workload": {"n_xl": N_XL, "n_full": N_FULL, "n_small": N_SMALL,
                      "n_test": N_TEST, "iters": ITERS,
                      "num_leaves": LEAVES, "max_bin": MAX_BIN,
                      "objective": "binary",

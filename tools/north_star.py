@@ -295,6 +295,13 @@ def main():
     table.update(peak)
     print("peak:", peak, flush=True)
     table["wave_kernel"] = wave_times(peak["peak_int8_tmacs"])
+    table["wave_kernel_note"] = (
+        "ns_per_row is the 1M->4M dispatch-wall slope; dispatch-latency "
+        "variance (~+-1 ms per point) puts ~+-0.3 ns/row error bars on "
+        "it, so small-wave utilizations carry wide bars (values near or "
+        "above 1.0 mean 'at the MXU roofline within measurement error', "
+        "not >100%).  peak_*_tmacs itself under-reads ~5-10%: each "
+        "chained step pays a clip+cast epilogue on the 67 MB product.")
     print("waves:", table["wave_kernel"], flush=True)
 
     B = collective_bytes_per_tree()
