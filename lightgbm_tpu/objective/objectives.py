@@ -514,11 +514,14 @@ class LambdarankNDCG(ObjectiveFunction):
 
     def globalize_rows(self, globalize, allgather):
         raise NotImplementedError(
-            "lambdarank is not supported with mod-rank multi-process "
-            "training: its per-query index structures address local "
-            "rows.  Use is_pre_partition=true with per-rank files that "
-            "keep queries whole (the loader enforces the same contract "
-            "for query data, reference dataset_loader.cpp:639-742).")
+            "lambdarank is not supported with MULTI-PROCESS training "
+            "(documented descope): its per-query pair structures "
+            "address rows by position, which the cross-process "
+            "row-block layout breaks.  Single-process distributed "
+            "training IS supported — tree_learner=data/voting on a "
+            "multi-device mesh shards the histogram work while the "
+            "objective sees the full row axis "
+            "(tests/test_lambdarank.py::test_lambdarank_data_parallel_mesh).")
 
     def init(self, metadata, num_data):
         super().init(metadata, num_data)
@@ -638,11 +641,15 @@ def _lambdarank_bucket_grads(s, valid, label, gain, imd, disc, sigma,
         hh = jnp.where(pv, sigma * sigma * sig * (1.0 - sig) * delta, 0.0)
         row_sign = jnp.where(better_row, 1.0, -1.0)
         signed = lam * row_sign
-        g = (jnp.zeros(M).at[order[:T]].add(jnp.sum(signed, axis=1))
-             .at[order].add(-jnp.sum(signed, axis=0)))
-        h = (jnp.zeros(M).at[order[:T]].add(jnp.sum(hh, axis=1))
-             .at[order].add(jnp.sum(hh, axis=0)))
-        return g, h
+        # accumulate in SORTED coordinates, then one inverse-permutation
+        # gather back — the equivalent per-original-index scatter-adds
+        # (4 of them) are the slow path on TPU
+        g_sorted = (jnp.pad(jnp.sum(signed, axis=1), (0, M - T))
+                    - jnp.sum(signed, axis=0))
+        h_sorted = (jnp.pad(jnp.sum(hh, axis=1), (0, M - T))
+                    + jnp.sum(hh, axis=0))
+        inv = jnp.argsort(order)
+        return g_sorted[inv], h_sorted[inv]
 
     if C >= nq:
         return jax.vmap(per_query)((s, valid, label, gain, imd))

@@ -120,3 +120,37 @@ def test_lambdarank_trains_on_block_path():
     assert bst._gbdt._can_block()
     res = bst._gbdt.eval_train()
     assert any(v > 0.8 for _, _, v, _ in res)
+
+
+def test_lambdarank_data_parallel_mesh():
+    """Single-process DISTRIBUTED lambdarank: tree_learner=data over the
+    8-device mesh must train and rank like the serial run (the
+    multi-PROCESS refusal in LambdarankNDCG.globalize_rows points
+    here as the supported distributed path)."""
+    import jax
+    if len(jax.devices()) < 8:
+        import pytest
+        pytest.skip("needs 8 virtual devices")
+    rng = np.random.RandomState(17)
+    sizes = rng.randint(5, 60, size=100)
+    qb = np.concatenate([[0], np.cumsum(sizes)])
+    n = qb[-1]
+    X = rng.normal(size=(n, 6)).astype(np.float32)
+    rel = np.clip((X[:, 0] + 0.4 * rng.normal(size=n)) * 1.3 + 1.5,
+                  0, 4).astype(np.float32)
+    params = {"objective": "lambdarank", "metric": "ndcg",
+              "ndcg_eval_at": [10], "num_leaves": 31,
+              "min_data_in_leaf": 5, "verbose": -1}
+    serial = lgb.train(params, lgb.Dataset(X, label=rel,
+                                           group=np.asarray(sizes)),
+                       20, verbose_eval=False,
+                       keep_training_booster=True)
+    dist = lgb.train({**params, "tree_learner": "data"},
+                     lgb.Dataset(X, label=rel, group=np.asarray(sizes)),
+                     20, verbose_eval=False, keep_training_booster=True)
+    rs = serial._gbdt.eval_train()
+    rd = dist._gbdt.eval_train()
+    vs = max(v for _, _, v, _ in rs)
+    vd = max(v for _, _, v, _ in rd)
+    assert vd > 0.8, (vd, vs)
+    assert abs(vd - vs) < 0.05, (vd, vs)
