@@ -14,7 +14,9 @@ from lightgbm_tpu.basic import Booster
 bst = Booster(params=params, train_set=ds)
 g = bst._gbdt
 fn = g._block_fn(4)
-lowered = fn.lower(g.device_data, g._bins_t, g.scores, jnp.float32(0.1), jnp.int32(0), jnp.int32(4))
+lowered = fn.lower(g.device_data, g._bins_t, tuple(g._valid_device),
+                   g.scores, tuple(g._valid_scores), jnp.float32(0.1),
+                   jnp.int32(0), jnp.int32(4))
 comp = lowered.compile()
 txt = comp.as_text()
 with open("/tmp/block_hlo.txt", "w") as f:
