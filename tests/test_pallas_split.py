@@ -179,3 +179,14 @@ def test_split_kernel_default_gating(monkeypatch):
     assert not split_kernel_ok(28, 64, True, num_rows=7000)   # categorical
     assert not split_kernel_ok(28, 48, False, num_rows=7000)  # non-pow2 B
     assert not split_kernel_ok(5, 8, False, num_rows=7000)    # 40 lanes
+
+
+def test_oracle_256_bins():
+    """B=256 — the real-data leg's bin stride (max_bin=255): decisions
+    must match the XLA scan at the widest supported stride, with and
+    without a feature mask."""
+    hs = _compare(3, L2=14, F=8, B=256,
+                  params=SplitParams(min_data_in_leaf=5))
+    assert hs.sum() >= 4
+    fm = jnp.asarray(np.array([1, 0, 1, 1, 0, 1, 1, 0], bool))
+    _compare(5, L2=14, F=8, B=256, feature_mask=fm)
