@@ -12,7 +12,6 @@ import os
 
 import numpy as np
 import jax.numpy as jnp
-import pytest
 
 from lightgbm_tpu.io.binning import (MISSING_NAN, MISSING_NONE,
                                      MISSING_ZERO)
