@@ -281,14 +281,21 @@ def ranking_leg(max_bin=255, iters_env="BENCH_RANK_ITERS",
                              "(docs/Experiments.rst)"}
 
 
-def _leg(line, name, fn, retries=1):
+def _leg(line, name, fn, retries=1, gate=False):
     """Run an auxiliary bench leg with one retry: a transient tunnel/
     compile error (observed: 'remote_compile: response body closed')
     must not erase a leg, and a doubly-failed AUXILIARY leg is recorded
     on the line — visible to any reader — without zeroing the HIGGS
-    headline (gate failures inside a leg that RAN still zero it)."""
+    headline (gate failures inside a leg that RAN still zero it).
+
+    ``gate=True`` marks a GATE-BEARING leg (valid/bin255/rank: a leg
+    whose quality gate would zero the headline had it run).  When such
+    a leg fails BOTH attempts with the SAME error — a deterministic
+    crash, not a transient — it lands in ``legs_hard_failed`` and main
+    zeroes ``vs_baseline``: a code regression that crashes the gate
+    path must not keep the headline green (ADVICE r5 #2)."""
     import gc
-    err = None
+    errs = []
     for attempt in range(retries + 1):
         try:
             return fn()
@@ -297,11 +304,13 @@ def _leg(line, name, fn, retries=1):
             # failed attempt's frames (and their multi-GB leg buffers)
             # alive, which would turn an OOM-class transient into a
             # deterministic OOM on retry
-            err = f"{type(exc).__name__}: {exc}"
+            errs.append(f"{type(exc).__name__}: {exc}")
             del exc
             gc.collect()
-    line[f"{name}_leg"] = f"failed: {err}"
+    line[f"{name}_leg"] = f"failed: {errs[-1]}"
     line.setdefault("legs_failed", []).append(name)
+    if gate and len(set(errs)) == 1:
+        line.setdefault("legs_hard_failed", []).append(name)
     return None
 
 
@@ -365,7 +374,8 @@ def main():
     # workflow must stay on the fused block path, within ~20% of the
     # no-valid leg's per-iteration cost
     if os.environ.get("BENCH_VALID", "1") != "0":
-        vleg = _leg(line, "valid", lambda: valid_leg(leaves, max_bin))
+        vleg = _leg(line, "valid", lambda: valid_leg(leaves, max_bin),
+                    gate=True)
         if vleg is not None:
             vleg["valid_block_ok"] = bool(vleg["valid_on_block_path"])
             # the slowdown gate only means something when the no-valid
@@ -390,7 +400,7 @@ def main():
         n255 = int(os.environ.get("BENCH_255_ROWS", 1_000_000))
         it255 = int(os.environ.get("BENCH_255_ITERS", 32))
         leg255 = _leg(line, "bin255", lambda: synthetic_leg(
-            n255, it255, leaves, 255, seed=2))
+            n255, it255, leaves, 255, seed=2), gate=True)
         if leg255 is not None:
             rps_255, auc_255 = leg255
             auc_255_ok = bool(auc_255 >= 0.85)
@@ -421,7 +431,7 @@ def main():
         import jax
         gc.collect()
         jax.clear_caches()
-        rank = _leg(line, "rank", ranking_leg)   # config-exact 255-bin
+        rank = _leg(line, "rank", ranking_leg, gate=True)  # config-exact 255-bin
         if rank is not None:
             line.update(rank)
             if not rank["rank_ndcg_ok"]:
@@ -431,7 +441,7 @@ def main():
         if os.environ.get("BENCH_RANK63", "1") != "0":
             rank63 = _leg(line, "rank63", lambda: ranking_leg(
                 max_bin=63, iters_env="BENCH_RANK63_ITERS",
-                iters_default=32))
+                iters_default=32), gate=True)
             if rank63 is not None:
                 line.update(rank63)
                 if not rank63["rank63_ndcg_ok"]:
@@ -439,6 +449,11 @@ def main():
 
     if not auc_ok:
         vs = 0.0    # a bench run that failed to learn scores zero
+    if line.get("legs_hard_failed"):
+        # a gate-bearing leg crashed deterministically (same error on
+        # both attempts): its gate never ran, so the headline must not
+        # stay green (ADVICE r5 #2)
+        vs = 0.0
     line["vs_baseline"] = round(vs, 4)
     line["legs_ok"] = "legs_failed" not in line
     line["auc_ok"] = auc_ok

@@ -99,14 +99,21 @@ import functools
 
 @functools.partial(jax.jit,
                    static_argnames=("num_leaves", "max_depth", "wave_size",
-                                    "hist_mode"))
+                                    "hist_mode", "split_kernel"))
 def _shared_serial_build(dd, grad, hess, bag, fmask, bins_t, split,
-                         *, num_leaves, max_depth, wave_size, hist_mode):
+                         *, num_leaves, max_depth, wave_size, hist_mode,
+                         split_kernel=True):
     """Module-level jitted serial tree build: shared across all GBDT
     instances, with SplitParams TRACED (only the shape-determining
     num_leaves/max_depth/wave_size are static) — so boosters differing
     only in regularization / min-data knobs reuse one compiled program
-    instead of recompiling (the dominant cost of the CPU test suite)."""
+    instead of recompiling (the dominant cost of the CPU test suite).
+
+    ``split_kernel`` is a pure CACHE KEY: when the fused split kernel is
+    disabled after a Mosaic compile failure (``ops/pallas_split``
+    global), the trace must re-run so the gate re-evaluates — without a
+    distinct static arg the old jaxpr (with the failing kernel baked in)
+    would be served from this shared cache forever."""
     growth = GrowthParams(num_leaves=num_leaves, max_depth=max_depth,
                           wave_size=wave_size, split=split)
     return build_tree(dd, grad, hess, growth, bag_mask=bag,
@@ -161,6 +168,15 @@ class GBDT:
         self.num_tree_per_iteration = config.num_tree_per_iteration
         self.mesh_ctx = None
         self._row_pad = 0
+        # early-stopping bookkeeping lives on the INSTANCE (not train()
+        # locals) so snapshots capture it and a resumed run keeps
+        # counting stall rounds from where the dead run stood
+        self._es_state: Dict[str, Dict] = {
+            "best_scores": {}, "best_iter": {}, "key_order": []}
+        # resume flag: train(num_iterations) treats the count as the
+        # TOTAL target after resume_from_snapshot (the dead run's
+        # target), vs "additional rounds" for continued training
+        self._resumed = False
 
         if train_set is not None:
             self._init_train(train_set)
@@ -350,12 +366,14 @@ class GBDT:
                                         feature_mask=fmask)
             else:
                 def _raw_build(dd, grad, hess, bag, fmask, bins_t=None):
+                    from ..ops.pallas_split import split_kernel_disabled
                     return _shared_serial_build(
                         dd, grad, hess, bag, fmask, bins_t, growth.split,
                         num_leaves=growth.num_leaves,
                         max_depth=growth.max_depth,
                         wave_size=growth.wave_size,
-                        hist_mode=hist_mode)
+                        hist_mode=hist_mode,
+                        split_kernel=not split_kernel_disabled())
         else:
             from ..parallel.learners import build_tree_distributed
             mesh = self.mesh_ctx.mesh
@@ -643,8 +661,17 @@ class GBDT:
             if pad:
                 bt = bt._replace(row_leaf=bt.row_leaf[:n])
             return bt
-        return self._jit_build(self.device_data, grad, hess, bag, fmask,
-                               self._bins_t)
+        try:
+            return self._jit_build(self.device_data, grad, hess, bag,
+                                   fmask, self._bins_t)
+        except Exception as exc:        # noqa: BLE001 - classified below
+            # a fused-split-kernel compile failure (Mosaic/VMEM) demotes
+            # to the XLA scan path and re-dispatches once; anything else
+            # propagates
+            if not self._maybe_split_kernel_fallback(exc):
+                raise
+            return self._jit_build(self.device_data, grad, hess, bag,
+                                   fmask, self._bins_t)
 
     def _renew_leaves(self, bt: BuiltTree, k: int) -> BuiltTree:
         """Objective-specific leaf re-fit (RenewTreeOutput,
@@ -1056,12 +1083,6 @@ class GBDT:
 
     _BLOCK_CAP = 32
 
-    # NOTE: no RESOURCE_EXHAUSTED — a deterministic HBM OOM must fail
-    # fast, not be retried behind "transient" warnings
-    _TRANSIENT_MARKERS = ("UNAVAILABLE", "DEADLINE_EXCEEDED",
-                          "Connection reset", "Broken pipe",
-                          "Socket closed")
-
     def _dispatch_retry(self, fn, *args):
         """Run a PURE jitted dispatch with transient-failure retries
         (the reference's socket layer retries sends the same way,
@@ -1070,22 +1091,26 @@ class GBDT:
         inputs are untouched until the result is assigned.  Covers the
         dispatch/compile path (where tunnel RPC failures surface
         synchronously); asynchronous execution faults still propagate
-        at the next fetch."""
-        last = None
-        for attempt in range(3):
-            try:
-                return fn(*args)
-            except Exception as exc:    # noqa: BLE001 - filtered below
-                msg = str(exc)
-                if not any(m in msg for m in self._TRANSIENT_MARKERS):
-                    raise
-                last = exc
-                if attempt < 2:       # no false "retrying" + sleep on
-                    log_warning(      # the final failure
-                        f"transient device error (attempt "
-                        f"{attempt + 1}/3), retrying: {msg[:200]}")
-                    time.sleep(1.0 + attempt)
-        raise last
+        at the next fetch.
+
+        Backoff/deadline/transient classification live on the SHARED
+        retry utility (``utils/retry.py``) since the fault-tolerance
+        round — the same policy the rendezvous and host collectives use;
+        ``LGBM_TPU_RETRY_*`` env knobs tune all of them together."""
+        from ..utils.retry import retry_call
+        return retry_call(fn, *args, what="device dispatch")
+
+    def _maybe_split_kernel_fallback(self, exc) -> bool:
+        """A Mosaic/VMEM compile failure of the fused split kernel must
+        degrade to the XLA scan path, not kill training (ADVICE r5 #1).
+        Returns True when the kernel was just disabled and the build
+        programs were rebuilt — the caller should re-dispatch once."""
+        from ..ops.pallas_split import disable_on_compile_error
+        if not disable_on_compile_error(exc):
+            return False
+        if self.train_set is not None:
+            self._setup_build_program()   # drop traces that bake the kernel
+        return True
 
     def _pick_block_len(self, nb: int) -> int:
         """Compiled scan length for a block of ``nb`` active iterations.
@@ -1152,12 +1177,23 @@ class GBDT:
             nb = min(num_iters - done, self._block_cap)
             fn = self._block_fn(self._pick_block_len(nb))
             with tag("block") as tdone:
-                (self.scores, vscores), trees = self._dispatch_retry(
-                    fn, self.device_data, self._bins_t,
-                    tuple(self._valid_device), self.scores,
-                    tuple(self._valid_scores),
-                    jnp.float32(self.shrinkage_rate),
-                    jnp.int32(self.iter), jnp.int32(nb))
+                args = (self.device_data, self._bins_t,
+                        tuple(self._valid_device), self.scores,
+                        tuple(self._valid_scores),
+                        jnp.float32(self.shrinkage_rate),
+                        jnp.int32(self.iter), jnp.int32(nb))
+                try:
+                    (self.scores, vscores), trees = self._dispatch_retry(
+                        fn, *args)
+                except Exception as exc:    # noqa: BLE001 - see below
+                    # split-kernel compile failure: the block programs
+                    # were rebuilt without the kernel — fetch the fresh
+                    # one and dispatch again (same pure inputs)
+                    if not self._maybe_split_kernel_fallback(exc):
+                        raise
+                    fn = self._block_fn(self._pick_block_len(nb))
+                    (self.scores, vscores), trees = self._dispatch_retry(
+                        fn, *args)
                 self._valid_scores = list(vscores)
                 tdone(trees.num_leaves)
             # init-score bias rides the pending entry and is baked into
@@ -1210,9 +1246,16 @@ class GBDT:
         (reference GBDT::Train gbdt.cpp:309-327 + Application::Train)."""
         c = self.config
         iters = num_iterations or c.num_iterations
-        best_scores: Dict[str, float] = {}
-        best_iter: Dict[str, int] = {}
-        key_order: List[str] = []
+        # ES bookkeeping is INSTANCE state since the fault-tolerance
+        # round: snapshots persist it and a resumed run keeps counting
+        # stall rounds exactly where the dead run stood.  A fresh (non-
+        # resumed) train() starts clean, as the old local dicts did.
+        if not self._resumed:
+            self._es_state = {"best_scores": {}, "best_iter": {},
+                              "key_order": []}
+        best_scores: Dict[str, float] = self._es_state["best_scores"]
+        best_iter: Dict[str, int] = self._es_state["best_iter"]
+        key_order: List[str] = self._es_state["key_order"]
         want_eval = bool(self.metrics
                          and (c.is_training_metric or self.valid_sets))
         es_on = c.early_stopping_round > 0 and bool(self.valid_sets)
@@ -1223,7 +1266,10 @@ class GBDT:
         if eval_freq <= 0 and es_on:
             eval_freq = 1
         stopped_early = False
-        it = 0
+        # resumed: num_iterations is the dead run's TOTAL target and
+        # self.iter sits mid-run — continue from there, keeping window
+        # boundaries (eval/snapshot cadence) aligned with the original
+        it = self.iter if self._resumed else 0
         while it < iters:
             # window to the next eval/snapshot boundary, run as one block
             window = iters - it
@@ -1295,9 +1341,7 @@ class GBDT:
                         stopped_early = True
                         break
             if c.snapshot_freq > 0 and it % c.snapshot_freq == 0:
-                path = f"{c.output_model}.snapshot_iter_{it}"
-                self.save_model(path)
-                log_info(f"saved snapshot to {path}")
+                self.save_snapshot(it)
         if not stopped_early and es_on and key_order:
             # the stall window never elapsed: still report the best seen
             # (the python callback raises at the final iteration with
@@ -1327,6 +1371,115 @@ class GBDT:
             self._stacked_cache = None
             log_warning(f"dropped {trimmed} trailing iteration(s) with no "
                         f"splittable leaves")
+
+    # -- snapshot / resume (fault tolerance) ----------------------------
+    def save_snapshot(self, iteration: Optional[int] = None) -> Optional[str]:
+        """Write an atomic snapshot (model + f32 score state + manifest)
+        and prune to ``snapshot_keep`` (see ``boosting/snapshot.py``).
+        Multi-process: rank 0 writes (every rank holds the identical
+        model; a shared filesystem would race otherwise)."""
+        if jax.process_count() > 1 and jax.process_index() != 0:
+            return None
+        from .snapshot import write_snapshot
+        return write_snapshot(self, self.iter if iteration is None
+                              else iteration)
+
+    def resume_from_snapshot(self, path_or_dir: str) -> int:
+        """Restore trees, scores, and early-stopping state from the
+        latest VALID snapshot under ``path_or_dir`` (a manifest path, a
+        snapshot model path, an ``output_model`` prefix, or a
+        directory), so a subsequent ``train(total_target)`` continues
+        exactly where the dead run died.  Returns the restored
+        iteration.
+
+        Scores restore bit-for-bit from the snapshot's f32 state
+        sidecar when present (the resumed run is then numerically
+        IDENTICAL to an uninterrupted one); without a usable sidecar
+        they are replayed from the restored trees — a last-ulp
+        approximation, warned about."""
+        from .snapshot import resolve_snapshot, config_hash
+        manifest = resolve_snapshot(path_or_dir)
+        if manifest is None:
+            raise FileNotFoundError(
+                f"no valid snapshot found at {path_or_dir!r}")
+        if self.train_set is None:
+            raise ValueError("resume_from_snapshot needs a booster with "
+                             "an attached training set")
+        if manifest["config_hash"] != config_hash(self.config):
+            log_warning("resuming with a DIFFERENT config than the "
+                        "snapshot was written with; the continued run "
+                        "will not match an uninterrupted one")
+
+        from ..utils.file_io import open_read
+        with open_read(manifest["model_path"]) as f:
+            text = f.read()
+        donor = GBDT(self.config, None)
+        donor.load_model_from_string(text)
+        if donor.num_tree_per_iteration != self.num_tree_per_iteration:
+            raise ValueError("cannot resume: num_tree_per_iteration "
+                             "differs between snapshot and config")
+        fmap = {f: i for i, f in enumerate(self.train_set.used_features)}
+        for t in donor.models:
+            t.align_with_mappers(self.train_set.mappers, fmap)
+        self.models = list(donor.models)
+        self.iter = manifest["iteration"]
+        self.init_score_value = manifest.get("init_score_value", 0.0)
+        self._es_state = {
+            "best_scores": dict(manifest.get("best_scores", {})),
+            "best_iter": {k: int(v) for k, v in
+                          manifest.get("best_iter", {}).items()},
+            "key_order": list(manifest.get("key_order", []))}
+        self._restore_scores(manifest)
+        self._resumed = True
+        self._stacked_cache = None
+        log_info(f"resumed from snapshot {manifest['model_path']} at "
+                 f"iteration {self.iter} ({len(self._host_models)} trees)")
+        return self.iter
+
+    def _restore_scores(self, manifest: Dict) -> None:
+        """Exact restore from the f32 sidecar when it fits this booster
+        (same train shape, same attached valid sets); tree replay
+        otherwise."""
+        K = max(1, self.num_tree_per_iteration)
+        state = None
+        if manifest.get("state_path") and self._pr is None:
+            state = np.load(manifest["state_path"])
+            s = state.get("scores")
+            want = (self.num_data, K)
+            if s is None or s.shape != want:
+                log_warning(f"snapshot score state has shape "
+                            f"{None if s is None else s.shape}, booster "
+                            f"needs {want}; replaying trees instead")
+                state = None
+        if state is not None:
+            self.scores = jax.device_put(
+                np.asarray(state["scores"], np.float32))
+            for i in range(len(self._valid_scores)):
+                vs = state.get(f"valid_scores_{i}")
+                if vs is not None and vs.shape == tuple(
+                        self._valid_scores[i].shape):
+                    self._valid_scores[i] = jnp.asarray(
+                        np.asarray(vs, np.float32))
+                else:
+                    self._replay_valid_scores(i)
+            return
+        # fallback: replay restored trees (tree 0 carries the baked
+        # init-score bias, so the replay starts from zero)
+        self.scores = jnp.zeros_like(self.scores)
+        for j, tree in enumerate(self._host_models):
+            pred = self._predict_host_tree_binned(tree, self.device_data)
+            self.scores = self.scores.at[:, j % K].add(pred)
+        for i in range(len(self._valid_scores)):
+            self._replay_valid_scores(i)
+
+    def _replay_valid_scores(self, i: int) -> None:
+        K = max(1, self.num_tree_per_iteration)
+        vd = self._valid_device[i]
+        score = jnp.zeros_like(self._valid_scores[i])
+        for j, tree in enumerate(self._host_models):
+            pred = self._predict_host_tree_binned(tree, vd)
+            score = score.at[:, j % K].add(pred)
+        self._valid_scores[i] = score
 
     # ------------------------------------------------------------------
     def num_trees(self) -> int:

@@ -6,10 +6,13 @@ Counterpart of the reference CLI
 InitTrain/Train/Predict): reads the same ``key=value`` config-file format
 (``train.conf``), supports ``task=train|predict|refit|convert_model``
 (`config.h:89-91`), data/valid files with ``.weight``/``.query`` side
-files, model save/load, and the fork's snapshot behavior.
+files, model save/load, and the fork's snapshot behavior — extended
+with resume: ``--resume`` (or ``resume_from=<path|prefix|dir|auto>``)
+restarts a preempted run from its newest VALID snapshot and continues
+to the original ``num_iterations`` target (README "Fault tolerance").
 
 Usage:
-    python -m lightgbm_tpu config=train.conf [key=value ...]
+    python -m lightgbm_tpu config=train.conf [key=value ...] [--resume]
 """
 from __future__ import annotations
 
@@ -28,10 +31,15 @@ def parse_cli_args(argv: List[str]) -> Dict[str, str]:
     kv: Dict[str, str] = {}
     for arg in argv:
         if "=" not in arg:
+            if arg.lstrip("-") == "resume":
+                # `--resume` (bare): pick up the newest valid snapshot
+                # under the output_model prefix
+                kv["resume_from"] = "auto"
+                continue
             log_warning(f"unknown argument {arg!r} (expected key=value)")
             continue
         k, v = arg.split("=", 1)
-        kv[k.strip()] = v.strip()
+        kv[k.strip().lstrip("-")] = v.strip()
     file_kv: Dict[str, str] = {}
     cfg_path = kv.get("config", kv.get("config_file"))
     if cfg_path:
@@ -125,11 +133,14 @@ def _run_train(cfg: Config, params) -> None:
     valid_sets = [Dataset(v, params=params, reference=train_set)
                   for v in cfg.valid_data]
     valid_names = [f"valid_{i}" for i in range(len(valid_sets))]
+    resume = cfg.resume_from or None
     booster = train(params, train_set, num_boost_round=cfg.num_iterations,
                     valid_sets=valid_sets, valid_names=valid_names,
-                    init_model=cfg.input_model or None,
+                    init_model=(cfg.input_model or None)
+                    if not resume else None,
                     early_stopping_rounds=cfg.early_stopping_round or None,
-                    verbose_eval=cfg.output_freq)
+                    verbose_eval=cfg.output_freq,
+                    resume_from=resume)
     import jax
     if jax.process_index() == 0:    # every rank holds the identical model
         booster.save_model(cfg.output_model)

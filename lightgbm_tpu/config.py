@@ -113,6 +113,8 @@ ALIAS_TABLE: Dict[str, str] = {
     "nodes": "machines",
     "subsample_for_bin": "bin_construct_sample_cnt",
     "metric_freq": "output_freq",
+    "resume": "resume_from",
+    "snapshot_keep_cnt": "snapshot_keep",
 }
 
 # Known canonical parameter names (reference config.h:456-492 parameter_set),
@@ -139,7 +141,8 @@ PARAMETER_SET = frozenset({
     "metric", "output_freq", "time_out", "gpu_platform_id", "gpu_device_id",
     "gpu_use_dp", "convert_model", "convert_model_language",
     "feature_fraction_seed", "enable_bundle", "data_filename",
-    "valid_data_filenames", "snapshot_freq", "sparse_threshold",
+    "valid_data_filenames", "snapshot_freq", "snapshot_keep",
+    "resume_from", "sparse_threshold",
     "enable_load_from_binary_file", "max_conflict_rate", "histogram_pool_size",
     "is_provide_training_metric", "machines", "zero_as_missing",
     "init_score_file", "valid_init_score_file", "max_cat_threshold",
@@ -252,6 +255,12 @@ class Config:
     output_freq: int = 1
     is_training_metric: bool = False
     snapshot_freq: int = -1
+    # fault tolerance: retain the newest K snapshots (current + a
+    # fallback in case a crash tears the current one mid-write), and an
+    # optional snapshot to resume a preempted run from ("auto" =
+    # newest valid snapshot under the output_model prefix)
+    snapshot_keep: int = 2
+    resume_from: str = ""
 
     # dart
     drop_rate: float = 0.1

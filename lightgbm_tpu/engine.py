@@ -29,9 +29,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
           evals_result: Optional[Dict] = None,
           verbose_eval=True, learning_rates=None,
           keep_training_booster: bool = False,
-          callbacks: Optional[Sequence] = None) -> Booster:
-    """Train one model (reference engine.py:18-310)."""
+          callbacks: Optional[Sequence] = None,
+          resume_from: Optional[str] = None) -> Booster:
+    """Train one model (reference engine.py:18-310).
+
+    ``resume_from``: restore a preempted run from its latest valid
+    snapshot (a snapshot/manifest path, an ``output_model`` prefix, a
+    directory, or ``"auto"`` = the configured ``output_model`` prefix)
+    and continue toward ``num_boost_round`` TOTAL iterations —
+    bit-for-bit where the snapshot carries its score state (see
+    ``boosting/snapshot.py``)."""
     params = canonicalize_params(dict(params or {}))
+    if resume_from is None and params.get("resume_from"):
+        resume_from = str(params["resume_from"])
     if "num_iterations" in params:
         num_boost_round = int(params["num_iterations"])
     params["num_iterations"] = num_boost_round
@@ -79,6 +89,19 @@ def train(params: Dict[str, Any], train_set: Dataset,
             params["is_training_metric"] = True
             continue
         booster.add_valid(vs, name)
+
+    if resume_from:
+        # AFTER valid sets attach (their score arrays restore from the
+        # snapshot's state sidecar); init_model + resume is rejected by
+        # iteration bookkeeping being mutually exclusive
+        if init_model is not None:
+            raise ValueError("resume_from and init_model are mutually "
+                             "exclusive: a resumed run continues its own "
+                             "snapshot, not another model")
+        target = resume_from
+        if target in ("auto", "latest"):
+            target = booster._gbdt.config.output_model
+        booster._gbdt.resume_from_snapshot(target)
 
     cbs = list(callbacks or [])
     if verbose_eval is True:
@@ -131,10 +154,13 @@ def train(params: Dict[str, Any], train_set: Dataset,
             booster.free_dataset()
         return booster
 
-    for it in range(num_boost_round):
+    # resumed runs on the callback path continue toward the TOTAL round
+    # target from the restored iteration
+    start_iter = booster._gbdt.iter if resume_from else 0
+    for it in range(start_iter, num_boost_round):
         env = callback_mod.CallbackEnv(
             model=booster, params=params, iteration=it,
-            begin_iteration=0, end_iteration=num_boost_round,
+            begin_iteration=start_iter, end_iteration=num_boost_round,
             evaluation_result_list=None)
         for cb in cbs_before:
             cb(env)

@@ -55,21 +55,33 @@ class ThreadedAllgather:
 
 def jax_process_allgather(obj) -> List[object]:
     """Multi-host allgather of a JSON-serializable object over DCN
-    (requires ``jax.distributed.initialize``; one entry per process)."""
-    import jax
-    from jax.experimental import multihost_utils
-    payload = json.dumps(obj).encode()
-    n = np.frombuffer(payload, np.uint8)
-    sizes = multihost_utils.process_allgather(
-        np.array([len(n)], np.int64))
-    cap = int(sizes.max())
-    padded = np.zeros(cap, np.uint8)
-    padded[:len(n)] = n
-    gathered = multihost_utils.process_allgather(padded)
-    sizes = np.asarray(sizes).reshape(-1)
-    gathered = np.asarray(gathered).reshape(len(sizes), cap)
-    return [json.loads(bytes(gathered[r, :int(sizes[r])]).decode())
-            for r in range(len(sizes))]
+    (requires ``jax.distributed.initialize``; one entry per process).
+
+    Retried with exponential backoff on RPC-transient failures (a DCN
+    blip during a week-long run must not kill it); the
+    ``collective.allgather`` fault point sits in front for the
+    robustness tests."""
+    from ..utils.faults import fault_point
+    from ..utils.retry import retry_call
+
+    def _gather():
+        fault_point("collective.allgather")
+        import jax
+        from jax.experimental import multihost_utils
+        payload = json.dumps(obj).encode()
+        n = np.frombuffer(payload, np.uint8)
+        sizes = multihost_utils.process_allgather(
+            np.array([len(n)], np.int64))
+        cap = int(sizes.max())
+        padded = np.zeros(cap, np.uint8)
+        padded[:len(n)] = n
+        gathered = multihost_utils.process_allgather(padded)
+        szs = np.asarray(sizes).reshape(-1)
+        g = np.asarray(gathered).reshape(len(szs), cap)
+        return [json.loads(bytes(g[r, :int(szs[r])]).decode())
+                for r in range(len(szs))]
+
+    return retry_call(_gather, what="collective.allgather")
 
 
 class ExternalCollectives:
@@ -162,7 +174,24 @@ def find_bins_distributed(X_local: np.ndarray,
                           categorical_features: Sequence[int] = ()
                           ) -> List[BinMapper]:
     """Feature-sharded distributed bin finding -> full mapper list,
-    identical on every rank (`dataset_loader.cpp:816-880`)."""
+    identical on every rank (`dataset_loader.cpp:816-880`).
+
+    Whatever collective backend the caller injects is wrapped in the
+    shared retry policy, with the ``collective.allgather`` fault point
+    in front — the seam the fault-injection tests drive.  The fault
+    fires BEFORE the backend touches any rank-synchronization state, so
+    a retried rank simply joins the collective late (the
+    ThreadedAllgather barrier and the reference's blocking sockets both
+    tolerate that)."""
+    from ..utils.faults import fault_point
+    from ..utils.retry import retrying
+    inner = allgather
+
+    def _ag(obj):
+        fault_point("collective.allgather")
+        return inner(obj)
+
+    allgather = retrying(_ag, what="collective.allgather")
     cat_set = set(int(c) for c in categorical_features)
     # 1. sync feature count to the min across ranks (:821)
     counts = allgather(int(X_local.shape[1]))

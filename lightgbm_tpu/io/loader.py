@@ -78,7 +78,18 @@ def parse_file(path: str, config: Config
                ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
                           Optional[np.ndarray], List[str], List[int]]:
     """-> (X, label, weight, query, feature_names, categorical_cols)."""
-    path = localize(path)          # remote schemes -> temp copy (file_io)
+    from ..utils.faults import fault_point
+    from ..utils.retry import retry_call
+
+    def _localize(p):
+        # named injection seam + retried remote fetch: a flaky remote
+        # filesystem read (the fork's HDFS shard download analog) is a
+        # transient, not a lost training run
+        fault_point("loader.read")
+        return localize(p)
+
+    path = retry_call(_localize, path,    # remote schemes -> temp copy
+                      what="loader.read")
     fmt = detect_format(path, config.has_header)
     header_names: Optional[List[str]] = None
     skip = 0

@@ -60,6 +60,70 @@ from .split import (K_EPSILON, K_MIN_SCORE, SplitParams, SplitResult,
 
 LANE = 128
 
+# F*B lane cap: at the old 32768 cap the kernel's [3*Lc, FB] f32
+# intermediates (ghc/gs/cl0/cl1, ~12 MB each at Lc=32) blew the ~16 MB
+# per-core VMEM and surfaced as a Mosaic compile crash instead of a
+# fallback (ADVICE r5 #1).  16384 keeps the minimum Lc=8 tile inside
+# the budget below; the tile shrinks as FB grows toward it.
+MAX_LANES = 16384
+
+# VMEM working-set budget for the leaf-tile choice: the kernel holds
+# roughly 6 concurrent [3*Lc, FB] f32 arrays in the missing path
+# (stacked channels, masked copies, both prefix-sum variants), so the
+# live set is ~72*Lc*FB bytes.  12 MiB leaves headroom under the ~16 MB
+# per-core VMEM for pipelining + the in/out blocks.  Override for
+# hardware-verified tuning with LGBM_TPU_SPLIT_VMEM_MB.
+_WORKING_SET_BYTES_PER_CELL = 72
+
+
+def _vmem_budget_bytes() -> int:
+    return int(float(os.environ.get("LGBM_TPU_SPLIT_VMEM_MB", 12))
+               * (1 << 20))
+
+
+# module-global kill switch: flipped by disable_on_compile_error when a
+# Mosaic/VMEM compile failure escapes the static gates anyway; every
+# later trace falls back to the XLA scan path (GBDT rebuilds its
+# programs — see _shared_serial_build's split_kernel cache key)
+_DISABLED = [False]
+
+# markers of a kernel-compile-class failure (vs a transient RPC fault,
+# which the retry layer owns)
+COMPILE_FAILURE_MARKERS = ("Mosaic", "mosaic", "VMEM", "vmem",
+                           "Failed to compile", "XLA compilation",
+                           "jellyfish", "INTERNAL: Compile")
+
+
+def split_kernel_disabled() -> bool:
+    return _DISABLED[0]
+
+
+def disable_split_kernel(reason: str = "") -> None:
+    if not _DISABLED[0]:
+        _DISABLED[0] = True
+        from ..utils.log import log_warning
+        log_warning("fused split kernel disabled for this process; "
+                    "falling back to the XLA scan path"
+                    + (f" ({reason})" if reason else ""))
+
+
+def enable_split_kernel() -> None:
+    """Re-arm (tests)."""
+    _DISABLED[0] = False
+
+
+def disable_on_compile_error(exc: BaseException) -> bool:
+    """If ``exc`` looks like a kernel compile failure, disable the
+    kernel process-wide and return True (caller should rebuild + retry
+    its program once)."""
+    if _DISABLED[0]:
+        return False
+    msg = str(exc)
+    if any(m in msg for m in COMPILE_FAILURE_MARKERS):
+        disable_split_kernel(msg[:200])
+        return True
+    return False
+
 
 def split_kernel_ok(num_features: int, B: int,
                     has_categorical: bool, num_rows: int = 0) -> bool:
@@ -73,14 +137,14 @@ def split_kernel_ok(num_features: int, B: int,
     row-scaled kernels and the fused call adds its own per-wave cost).
     Default: on for datasets at/below the compile-lean row threshold,
     where op overhead rules; LGBM_TPU_SPLIT_KERNEL=1/0 forces."""
-    if has_categorical:
+    if has_categorical or _DISABLED[0]:
         return False
     env = os.environ.get("LGBM_TPU_SPLIT_KERNEL", "")
     if env in ("0", "false"):
         return False
     if B & (B - 1) or B > 256:
         return False
-    if (num_features * B) % LANE != 0 or num_features * B > 32768:
+    if (num_features * B) % LANE != 0 or num_features * B > MAX_LANES:
         return False
     if env in ("1", "true"):
         return True
@@ -88,9 +152,17 @@ def split_kernel_ok(num_features: int, B: int,
     return num_rows <= lean
 
 
-def _leaf_tile(L2: int) -> int:
+def _leaf_tile(L2: int, FB: int = LANE) -> int:
+    """Leaf-tile height, budgeted against the F*B lane width so the
+    kernel's ~[3*Lc, FB] f32 working set stays inside VMEM (ADVICE r5
+    #1: a fixed 32-leaf tile at wide FB compile-crashed instead of
+    shrinking).  Power of two in [8, 32]."""
+    cap = 32
+    budget = _vmem_budget_bytes()
+    while cap > 8 and cap * FB * _WORKING_SET_BYTES_PER_CELL > budget:
+        cap //= 2
     t = 8
-    while t < min(L2, 32):
+    while t < min(L2, cap):
         t *= 2
     return t
 
@@ -222,7 +294,7 @@ def find_best_splits_pallas(grid: jnp.ndarray,
     L2, F, Bg, _ = grid.shape
     assert Bg == B
     FB = F * B
-    Lc = _leaf_tile(L2)
+    Lc = _leaf_tile(L2, FB)
     L_pad = -(-L2 // Lc) * Lc
 
     chans = [jnp.pad(grid[..., i].reshape(L2, FB),

@@ -42,8 +42,22 @@ def init_distributed(coordinator_address: Optional[str] = None,
         kwargs["num_processes"] = num_processes
     if process_id is not None:
         kwargs["process_id"] = process_id
-    try:
+
+    from ..utils.faults import fault_point
+    from ..utils.retry import RetryPolicy, retry_call
+
+    def _connect():
+        # injection seam for the rendezvous handshake (the fault the
+        # fork's YARN workers see when the AM isn't up yet)
+        fault_point("rendezvous.connect")
         jax.distributed.initialize(**kwargs)
+
+    try:
+        # retried with backoff: at pod startup the coordinator may come
+        # up seconds after the workers (the reference's socket Connect
+        # loops with time_out retries, linkers_socket.cpp:225-274)
+        retry_call(_connect, policy=RetryPolicy.from_env(),
+                   what="rendezvous.connect")
     except RuntimeError as exc:
         # idempotent entry: the CLI's already-meshed probe reads private
         # jax state and may miss on a future jax — double-initialize

@@ -92,6 +92,49 @@ def release(path: str) -> None:
             pass
 
 
+def atomic_write(path: str, payload, binary: bool = False,
+                 chunks: int = 1) -> None:
+    """Crash-safe local write: the payload lands in ``path + ".tmp"``
+    first and is published with one ``os.replace`` — readers never see a
+    half-written file under the final name (the snapshot layer's
+    atomicity contract; reference snapshots write in place and a
+    preemption mid-write corrupts them).
+
+    ``chunks > 1`` splits the payload into that many writes with a
+    ``snapshot.write`` fault point between them, so the fault harness
+    can simulate dying mid-file: the torn bytes stay in the ``.tmp``
+    file and the published name is never touched.
+
+    Registered remote schemes have no rename, so they get a plain
+    streamed write (their stores are typically already
+    write-then-commit)."""
+    from .faults import fault_point
+    opener = _find_opener(path)
+    if opener is not None:
+        with opener(path, "wb" if binary else "w") as f:
+            f.write(payload)
+        return
+    tmp = path + ".tmp"
+    with open(tmp, "wb" if binary else "w") as f:
+        if chunks <= 1:
+            f.write(payload)
+        else:
+            # EXACTLY `chunks` slices -> exactly chunks-1 fault-point
+            # calls per write: injection timing must not depend on
+            # payload length parity (a floor-div step can yield an
+            # extra slice on odd lengths)
+            bounds = [len(payload) * i // chunks
+                      for i in range(chunks + 1)]
+            for i in range(chunks):
+                if i:
+                    f.flush()
+                    fault_point("snapshot.write")
+                f.write(payload[bounds[i]:bounds[i + 1]])
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
 def localize(path: str) -> str:
     """Return a real OS path for ``path``: identity for local files,
     a temp-file copy for registered remote schemes (per-rank shard

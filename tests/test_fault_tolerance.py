@@ -1,0 +1,295 @@
+"""Fault-tolerance suite: atomic snapshot/resume, retention, corruption
+fallback, early-stopping state survival, and the bench hard-gate policy.
+
+Every scenario drives a REAL failure through the named injection points
+in ``lightgbm_tpu/utils/faults.py`` — the tests prove the claims the
+README "Fault tolerance" section makes.
+"""
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting import snapshot as snap
+from lightgbm_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear()
+    yield
+    faults.clear()
+
+
+def _binary_data(n=600, f=6, seed=11):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] - 0.5 * X[:, 2]
+         + rng.normal(scale=0.5, size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def _params(prefix, **kw):
+    p = {"objective": "binary", "num_leaves": 7, "min_data_in_leaf": 5,
+         "learning_rate": 0.1, "verbose": -1, "snapshot_freq": 4,
+         "output_model": str(prefix)}
+    p.update(kw)
+    return p
+
+
+def _train(X, y, prefix, rounds=12, **kw):
+    resume_from = kw.pop("resume_from", None)
+    return lgb.train(_params(prefix, **kw), lgb.Dataset(X, label=y),
+                     num_boost_round=rounds, verbose_eval=False,
+                     resume_from=resume_from)
+
+
+def test_snapshot_bundle_written_and_validates(tmp_path):
+    """Each snapshot = model + f32 state sidecar + manifest commit
+    marker with checksums; no .tmp residue survives a clean run."""
+    X, y = _binary_data()
+    prefix = tmp_path / "m.txt"
+    _train(X, y, prefix, rounds=8, snapshot_keep=8)
+    snaps = snap.list_snapshots(str(prefix))
+    assert [it for it, _ in snaps] == [8, 4]
+    for it, manifest_path in snaps:
+        m = snap.validate_snapshot(manifest_path)
+        assert m is not None
+        assert m["iteration"] == it
+        assert m["num_trees"] == it          # one tree per iteration
+        assert os.path.exists(m["model_path"])
+        assert m["state_path"]               # exact-resume sidecar
+        st = np.load(m["state_path"])
+        assert st["scores"].shape == (len(y), 1)
+        assert st["scores"].dtype == np.float32
+    assert not [f for f in os.listdir(tmp_path) if f.endswith(".tmp")]
+
+
+def test_resume_bit_identical_after_kill(tmp_path):
+    """The acceptance scenario: a run killed by an injected fault while
+    writing the iteration-8 snapshot resumes from the intact iteration-4
+    snapshot and produces a final model BYTE-IDENTICAL to an
+    uninterrupted run with the same seed."""
+    X, y = _binary_data()
+    text_a = _train(X, y, tmp_path / "A.txt").model_to_string()
+
+    # killed run: first snapshot lands, the second tears mid-file
+    prefix_b = tmp_path / "B.txt"
+    faults.inject("snapshot.write", times=1, skip=1)
+    with pytest.raises(faults.FaultInjected):
+        _train(X, y, prefix_b)
+    assert faults.fired("snapshot.write") == 1
+    faults.clear()
+
+    # the torn write never published: latest VALID snapshot is iter 4,
+    # and only its .tmp residue marks the crash
+    m = snap.latest_valid_snapshot(str(prefix_b))
+    assert m is not None and m["iteration"] == 4
+
+    bst = _train(X, y, prefix_b, resume_from=str(prefix_b))
+    assert bst.model_to_string() == text_a
+
+
+def test_corrupted_latest_falls_back_to_previous(tmp_path):
+    """A truncated model file (or an unparsable manifest) fails
+    checksum validation and loading auto-selects the previous
+    snapshot."""
+    X, y = _binary_data()
+    prefix = tmp_path / "m.txt"
+    _train(X, y, prefix, rounds=12, snapshot_keep=8)
+    snaps = snap.list_snapshots(str(prefix))
+    assert [it for it, _ in snaps] == [12, 8, 4]
+
+    # truncate the newest model file
+    newest = snap.validate_snapshot(snaps[0][1])["model_path"]
+    with open(newest) as f:
+        text = f.read()
+    with open(newest, "w") as f:
+        f.write(text[:len(text) // 2])
+    m = snap.latest_valid_snapshot(str(prefix))
+    assert m["iteration"] == 8
+
+    # an unparsable manifest drops that snapshot the same way
+    with open(snaps[1][1], "w") as f:
+        f.write("{ torn json")
+    m = snap.latest_valid_snapshot(str(prefix))
+    assert m["iteration"] == 4
+
+    # resume still works from the surviving snapshot
+    bst = _train(X, y, prefix, resume_from=str(prefix))
+    assert bst.current_iteration == 12
+
+
+def test_retention_prunes_to_snapshot_keep(tmp_path):
+    X, y = _binary_data()
+    prefix = tmp_path / "m.txt"
+    _train(X, y, prefix, rounds=12, snapshot_freq=2, snapshot_keep=2)
+    snaps = snap.list_snapshots(str(prefix))
+    assert [it for it, _ in snaps] == [12, 10]
+    # pruned snapshots removed their model + state files too
+    names = os.listdir(tmp_path)
+    for it in (2, 4, 6, 8):
+        assert not [n for n in names if f"snapshot_iter_{it}" in n
+                    and not f"snapshot_iter_1{it}" in n], (it, names)
+
+
+def test_early_stopping_state_survives_resume(tmp_path):
+    """Killed mid-run with early stopping armed: the resumed run keeps
+    the best-score/best-iteration bookkeeping from the manifest and
+    lands on the SAME best_iteration (and final model bytes) as the
+    uninterrupted run."""
+    X, y = _binary_data(n=500, seed=3)
+    Xv, yv = _binary_data(n=300, seed=4)
+
+    def run(prefix, resume_from=None):
+        params = _params(prefix, metric="auc", snapshot_freq=4)
+        train = lgb.Dataset(X, label=y, params=params)
+        valid = train.create_valid(Xv, label=yv)
+        return lgb.train(params, train, num_boost_round=24,
+                         valid_sets=[valid], early_stopping_rounds=30,
+                         verbose_eval=False, resume_from=resume_from)
+
+    bst_a = run(tmp_path / "A.txt")
+    assert bst_a.best_iteration > 8      # the kill point must be earlier
+
+    prefix_b = tmp_path / "B.txt"
+    faults.inject("snapshot.write", times=1, skip=1)   # dies at iter 8
+    with pytest.raises(faults.FaultInjected):
+        run(prefix_b)
+    faults.clear()
+    m = snap.latest_valid_snapshot(str(prefix_b))
+    assert m["iteration"] == 4
+    assert m["best_iter"]                # ES bookkeeping in the manifest
+
+    bst_b = run(prefix_b, resume_from=str(prefix_b))
+    assert bst_b.best_iteration == bst_a.best_iteration
+    assert bst_b.best_score == bst_a.best_score
+    assert bst_b.model_to_string() == bst_a.model_to_string()
+
+
+def test_resume_auto_and_cli_flag(tmp_path):
+    """`resume_from="auto"` resolves the output_model prefix; the CLI
+    maps a bare `--resume` to it."""
+    X, y = _binary_data()
+    prefix = tmp_path / "m.txt"
+    _train(X, y, prefix, rounds=8)
+    bst = _train(X, y, prefix, resume_from="auto")
+    assert bst.current_iteration == 12
+
+    from lightgbm_tpu.cli import parse_cli_args
+    kv = parse_cli_args(["task=train", "--resume"])
+    assert kv["resume_from"] == "auto"
+    kv = parse_cli_args(["resume_from=/some/dir"])
+    assert kv["resume_from"] == "/some/dir"
+
+
+def test_resume_without_snapshot_raises(tmp_path):
+    X, y = _binary_data()
+    with pytest.raises(FileNotFoundError):
+        _train(X, y, tmp_path / "none.txt",
+               resume_from=str(tmp_path / "none.txt"))
+
+
+def test_resume_rejects_init_model(tmp_path):
+    X, y = _binary_data()
+    bst = _train(X, y, tmp_path / "m.txt", rounds=4)
+    with pytest.raises(ValueError, match="mutually exclusive"):
+        lgb.train(_params(tmp_path / "m.txt"), lgb.Dataset(X, label=y),
+                  num_boost_round=8, verbose_eval=False,
+                  init_model=bst.model_to_string(),
+                  resume_from=str(tmp_path / "m.txt"))
+
+
+def test_resume_without_state_sidecar_replays_trees(tmp_path):
+    """Deleting the .npz sidecar forces the tree-replay fallback: the
+    resumed model still trains to the full round count and stays close
+    to the uninterrupted model (replay re-rounds through f64, so exact
+    bit-identity is only promised WITH the sidecar)."""
+    X, y = _binary_data()
+    prefix = tmp_path / "m.txt"
+    _train(X, y, prefix, rounds=8)
+    m = snap.latest_valid_snapshot(str(prefix))
+    os.unlink(m["state_path"])
+    manifest = json.load(open(snap.list_snapshots(str(prefix))[0][1]))
+    bst = _train(X, y, prefix, resume_from=str(prefix))
+    assert bst.current_iteration == 12
+    assert bst.num_trees() == 12
+    p = bst.predict(X, raw_score=True)
+    assert np.isfinite(p).all()
+    assert manifest["iteration"] == 8
+
+
+def test_config_snapshot_params():
+    from lightgbm_tpu.config import Config
+    cfg = Config.from_params({"snapshot_keep": "3", "resume": "x",
+                              "snapshot_freq": 5})
+    assert cfg.snapshot_keep == 3
+    assert cfg.resume_from == "x"
+    assert cfg.snapshot_freq == 5
+
+
+def test_env_armed_fault(monkeypatch):
+    """LGBM_TPU_FAULTS arms points without touching code (chaos-run
+    path); the loader.read fault is retried by the shared policy."""
+    from lightgbm_tpu.utils import retry
+    monkeypatch.setattr(retry, "_sleep", lambda s: None)
+    monkeypatch.setenv("LGBM_TPU_FAULTS", "loader.read:1")
+    faults.clear()
+    faults._env_loaded = False           # re-read the env spec
+    import tempfile
+    with tempfile.NamedTemporaryFile("w", suffix=".csv",
+                                     delete=False) as f:
+        f.write("1,0.5,0.2\n0,0.1,0.9\n")
+        path = f.name
+    try:
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.io.loader import parse_file
+        X, label, _w, _q, _names, _cat = parse_file(
+            path, Config.from_params({}))
+        assert X.shape == (2, 2)
+        assert faults.fired("loader.read") == 1   # fired, then recovered
+    finally:
+        os.unlink(path)
+
+
+def _load_bench():
+    import importlib.util
+    path = os.path.join(os.path.dirname(__file__), "..", "bench.py")
+    spec = importlib.util.spec_from_file_location("_bench_under_test", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_bench_hard_gate_on_deterministic_leg_crash():
+    """ADVICE r5 #2: a gate-bearing leg that crashes BOTH attempts with
+    the same error lands in legs_hard_failed (main zeroes vs_baseline);
+    differing errors (transient-looking) or non-gate legs do not."""
+    bench = _load_bench()
+
+    def boom():
+        raise ValueError("deterministic crash")
+
+    line = {}
+    assert bench._leg(line, "valid", boom, gate=True) is None
+    assert line["legs_failed"] == ["valid"]
+    assert line["legs_hard_failed"] == ["valid"]
+
+    # differing errors: retried transient, no hard gate
+    line = {}
+    errs = iter(["first", "second"])
+
+    def flaky():
+        raise ValueError(next(errs))
+
+    bench._leg(line, "rank", flaky, gate=True)
+    assert line["legs_failed"] == ["rank"]
+    assert "legs_hard_failed" not in line
+
+    # non-gate leg: recorded, never hard-gates
+    line = {}
+    bench._leg(line, "full", boom, gate=False)
+    assert line["legs_failed"] == ["full"]
+    assert "legs_hard_failed" not in line
