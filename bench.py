@@ -21,6 +21,11 @@ Two throughput legs, BOTH at reference shape (28 features, 255 leaves):
 The reported headline `vs_baseline` is the MINIMUM of the legs run —
 no leg may lean on the other.
 
+Every leg reports its compile vs steady-state wall-clock split
+(`compile_s` — sourced from the telemetry summary's `gbdt.block_compile`
+span — and `steady_s`, the timed pass), so a compile-time regression
+can't hide inside a throughput number and vice versa.
+
 Real data: when reachable, the bench ALSO trains the reference's own
 7000-row binary_classification example at its own train.conf settings
 (100 trees, bagging + feature_fraction; eval AUC on binary.test), or any
@@ -42,6 +47,17 @@ REF_EXAMPLE = "/root/reference/examples/binary_classification"
 def _auc(y, s):
     from lightgbm_tpu.metric.metrics import binary_auc
     return binary_auc(y, s)
+
+
+def _block_compile_s():
+    """Cumulative XLA-compile wall-clock so far, sourced from the
+    telemetry run summary (the `gbdt.block_compile` span bills every
+    dispatch that traced+compiled a new block program).  Legs diff this
+    around their warm/timed phases to split compile from steady state."""
+    from lightgbm_tpu import obs
+    obs.enable()                    # idempotent; in-memory summary only
+    spans = obs.summary()["spans"]
+    return spans.get("gbdt.block_compile", {}).get("total_s", 0.0)
 
 
 def real_data_eval():
@@ -72,9 +88,11 @@ def real_data_eval():
               "bagging_fraction": 0.8, "verbose": -1,
               "num_iterations": iters}
     ds = lgb.Dataset(train_path, params=params)
+    c0 = _block_compile_s()
     t0 = time.time()
     bst = lgb.train(params, ds)
     wall = time.time() - t0
+    cold_compile_s = _block_compile_s() - c0
     # evaluate the cold-timed model BEFORE the warm re-train appends
     # trees (an early-stopped cold run would otherwise eval warm trees)
     from lightgbm_tpu.io.loader import load_raw_matrix
@@ -89,12 +107,13 @@ def real_data_eval():
     return {"real_data": name, "real_data_iters": iters,
             "real_data_eval_auc": round(auc, 5),
             "real_data_train_s": round(wall, 1),
+            "real_data_compile_s": round(cold_compile_s, 3),
             "real_data_train_warm_s": round(warm, 1)}
 
 
 def synthetic_leg(n, iters, leaves, max_bin, f=28, seed=0):
     """Steady-state training throughput at (n, iters); -> (row_iters/s,
-    train AUC)."""
+    train AUC, {"compile_s", "steady_s"})."""
     import jax
     import lightgbm_tpu as lgb
     from lightgbm_tpu.basic import Booster
@@ -109,6 +128,7 @@ def synthetic_leg(n, iters, leaves, max_bin, f=28, seed=0):
               "max_bin": max_bin, "learning_rate": 0.1,
               "min_data_in_leaf": 20, "verbose": -1}
     bst = Booster(params=params, train_set=ds)
+    c0 = _block_compile_s()
     # warmup: compiles the block program and reaches steady state.  A
     # cap-length window covers every compiled block size the timed pass
     # uses (residue lengths borrow the cap program, masked), so warming
@@ -122,6 +142,8 @@ def synthetic_leg(n, iters, leaves, max_bin, f=28, seed=0):
     bst._gbdt.train_block(iters)
     _sync(bst._gbdt.scores)
     wall = time.time() - t0
+    phases = {"compile_s": round(_block_compile_s() - c0, 3),
+              "steady_s": round(wall, 3)}
 
     # accuracy gate (VERDICT r1 #6): the timed model must actually
     # learn — train AUC on the synthetic separable signal, mirroring
@@ -134,7 +156,7 @@ def synthetic_leg(n, iters, leaves, max_bin, f=28, seed=0):
     del bst, ds
     import gc
     gc.collect()
-    return n * iters / wall, auc
+    return n * iters / wall, auc, phases
 
 
 def _sync(x):
@@ -172,6 +194,7 @@ def valid_leg(leaves, max_bin, f=28):
     del X
     # early_stopping_round high enough that the timed window never
     # stops: the leg times the with-valid machinery, not a short run
+    c0 = _block_compile_s()
     bst = lgb.train(dict(params, early_stopping_round=10_000), ds,
                     num_boost_round=iters, valid_sets=[vs],
                     verbose_eval=False)
@@ -185,6 +208,7 @@ def valid_leg(leaves, max_bin, f=28):
     _sync(g.scores)
     wall = time.time() - t0
     auc = float(_auc(y[n:], np.asarray(g._valid_scores[0][:, 0])))
+    compile_s = _block_compile_s() - c0
     del bst, ds, vs
     import gc
     gc.collect()
@@ -192,6 +216,8 @@ def valid_leg(leaves, max_bin, f=28):
             "valid_iters": iters,
             "valid_row_iters_per_sec": round(n * iters / wall, 1),
             "valid_eval_auc": round(auc, 5),
+            "valid_compile_s": round(compile_s, 3),
+            "valid_steady_s": round(wall, 3),
             "valid_on_block_path": bool(g._can_block())}
 
 
@@ -256,6 +282,7 @@ def ranking_leg(max_bin=255, iters_env="BENCH_RANK_ITERS",
         else:
             os.environ["LGBM_TPU_BLOCK_CAP"] = prev_cap
     g = bst._gbdt
+    c0 = _block_compile_s()
     bst.update()                    # compiles block + objective buckets
     g.train_block(iters)
     _sync(g.scores)
@@ -263,6 +290,7 @@ def ranking_leg(max_bin=255, iters_env="BENCH_RANK_ITERS",
     g.train_block(iters)
     _sync(g.scores)
     wall = time.time() - t0
+    compile_s = _block_compile_s() - c0
     m = NDCGMetric(Config.from_params(params))
     qb = np.concatenate([[0], np.cumsum(sizes)])
     (_, ndcg10, _), = m.eval(rel, np.asarray(g.scores[:, 0]), None, qb)
@@ -272,6 +300,8 @@ def ranking_leg(max_bin=255, iters_env="BENCH_RANK_ITERS",
     gc.collect()
     return {f"{p}_docs": n, f"{p}_queries": n_q, f"{p}_iters": iters,
             f"{p}_max_bin": max_bin,
+            f"{p}_compile_s": round(compile_s, 3),
+            f"{p}_steady_s": round(wall, 3),
             f"{p}_doc_iters_per_sec": round(rate, 1),
             f"{p}_ndcg10": round(float(ndcg10), 5),
             f"{p}_ndcg_ok": bool(ndcg10 >= 0.60),
@@ -332,7 +362,7 @@ def main():
     except Exception as exc:      # real-data leg must never kill the bench
         real = {"real_data": f"failed: {exc}"}
 
-    rps, auc = synthetic_leg(n, iters, leaves, max_bin)
+    rps, auc, ph = synthetic_leg(n, iters, leaves, max_bin)
     auc_ok = bool(auc >= 0.85)
     vs = rps / REFERENCE_ROW_ITERS_PER_SEC
     line = {
@@ -342,6 +372,8 @@ def main():
         "train_auc": round(auc, 5),
         "auc_ok": auc_ok,
         "throughput_data": "synthetic HIGGS-shaped",
+        "compile_s": ph["compile_s"],
+        "steady_s": ph["steady_s"],
     }
 
     if os.environ.get("BENCH_FULL", "1") != "0":
@@ -355,7 +387,7 @@ def main():
         full = _leg(line, "full", lambda: synthetic_leg(
             n_full, it_full, leaves, max_bin, seed=1))
         if full is not None:
-            rps_f, auc_f = full
+            rps_f, auc_f, ph_f = full
             auc_f_ok = bool(auc_f >= 0.85)
             line.update({
                 "full_rows": n_full, "full_iters": it_full,
@@ -364,6 +396,8 @@ def main():
                 "full_auc_ok": auc_f_ok,
                 "full_vs_baseline": round(
                     rps_f / REFERENCE_ROW_ITERS_PER_SEC, 4),
+                "full_compile_s": ph_f["compile_s"],
+                "full_steady_s": ph_f["steady_s"],
             })
             auc_ok = auc_ok and auc_f_ok
             vs = min(vs, rps_f / REFERENCE_ROW_ITERS_PER_SEC)
@@ -402,7 +436,7 @@ def main():
         leg255 = _leg(line, "bin255", lambda: synthetic_leg(
             n255, it255, leaves, 255, seed=2), gate=True)
         if leg255 is not None:
-            rps_255, auc_255 = leg255
+            rps_255, auc_255, ph_255 = leg255
             auc_255_ok = bool(auc_255 >= 0.85)
             line.update({
                 "bin255_rows": n255, "bin255_iters": it255,
@@ -411,6 +445,8 @@ def main():
                 "bin255_auc_ok": auc_255_ok,
                 "bin255_vs_baseline": round(
                     rps_255 / REFERENCE_ROW_ITERS_PER_SEC, 4),
+                "bin255_compile_s": ph_255["compile_s"],
+                "bin255_steady_s": ph_255["steady_s"],
             })
             auc_ok = auc_ok and auc_255_ok
 

@@ -10,17 +10,18 @@ from .utils.compile_cache import enable_default_compile_cache
 
 enable_default_compile_cache()
 
+from . import obs
 from .basic import Booster, Dataset
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
-                       record_evaluation, reset_parameter)
+                       record_evaluation, reset_parameter, telemetry)
 from .engine import cv, train
 
 __version__ = "0.1.0"
 
 __all__ = [
-    "Dataset", "Booster", "train", "cv",
+    "Dataset", "Booster", "train", "cv", "obs",
     "early_stopping", "print_evaluation", "record_evaluation",
-    "reset_parameter", "EarlyStopException",
+    "reset_parameter", "EarlyStopException", "telemetry",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_tree",
 ]

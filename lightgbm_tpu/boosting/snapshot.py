@@ -45,6 +45,7 @@ from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
+from ..obs import counter_add, span
 from ..utils.file_io import atomic_write
 from ..utils.log import log_info, log_warning
 
@@ -79,7 +80,8 @@ def config_hash(config) -> str:
     # of these change what gets computed
     for k in ("output_model", "output_result", "data", "valid_data",
               "input_model", "machine_list_file", "machines",
-              "resume_from", "snapshot_keep", "snapshot_freq", "verbose"):
+              "resume_from", "snapshot_keep", "snapshot_freq", "verbose",
+              "telemetry_output"):
         d.pop(k, None)
     payload = json.dumps(d, sort_keys=True, default=str)
     return _sha256_bytes(payload.encode())
@@ -96,46 +98,54 @@ def write_snapshot(gbdt, iteration: int, prefix: Optional[str] = None,
     keep = keep if keep is not None else getattr(c, "snapshot_keep", 2)
     model_path, state_path, manifest_path = snapshot_paths(prefix, iteration)
 
-    model_text = gbdt.save_model_to_string(-1)
-    # two chunks: the `snapshot.write` fault point sits between them
-    # (utils/file_io.atomic_write), so tests can tear the write mid-file
-    atomic_write(model_path, model_text, chunks=2)
+    with span("snapshot.write", iteration=int(iteration)) as sp:
+        model_text = gbdt.save_model_to_string(-1)
+        # two chunks: the `snapshot.write` fault point sits between them
+        # (utils/file_io.atomic_write), so tests can tear the write mid-file
+        atomic_write(model_path, model_text, chunks=2)
 
-    # f32 score state: exact-resume sidecar.  Multi-process global
-    # score arrays span other hosts' devices — skip the sidecar there
-    # (resume falls back to tree replay).
-    state = {}
-    if getattr(gbdt, "_pr", None) is None and gbdt.train_set is not None:
-        state["scores"] = np.asarray(gbdt.scores)
-        for i, vs in enumerate(gbdt._valid_scores):
-            state[f"valid_scores_{i}"] = np.asarray(vs)
-    if state:
-        import io
-        buf = io.BytesIO()
-        np.savez(buf, **state)
-        atomic_write(state_path, buf.getvalue(), binary=True)
+        # f32 score state: exact-resume sidecar.  Multi-process global
+        # score arrays span other hosts' devices — skip the sidecar there
+        # (resume falls back to tree replay).
+        state = {}
+        if getattr(gbdt, "_pr", None) is None and gbdt.train_set is not None:
+            state["scores"] = np.asarray(gbdt.scores)
+            for i, vs in enumerate(gbdt._valid_scores):
+                state[f"valid_scores_{i}"] = np.asarray(vs)
+        state_bytes = 0
+        if state:
+            import io
+            buf = io.BytesIO()
+            np.savez(buf, **state)
+            state_bytes = len(buf.getvalue())
+            atomic_write(state_path, buf.getvalue(), binary=True)
 
-    es = getattr(gbdt, "_es_state", None) or {}
-    manifest = {
-        "version": MANIFEST_VERSION,
-        "iteration": int(iteration),
-        "num_trees": int(gbdt.num_trees()),
-        "num_tree_per_iteration": int(max(1, gbdt.num_tree_per_iteration)),
-        "init_score_value": float(gbdt.init_score_value),
-        "config_hash": config_hash(c),
-        "model_file": os.path.basename(model_path),
-        "model_size": len(model_text.encode()),
-        "model_sha256": _sha256_bytes(model_text.encode()),
-        "state_file": os.path.basename(state_path) if state else "",
-        "state_sha256": _sha256_file(state_path) if state else "",
-        "best_scores": dict(es.get("best_scores", {})),
-        "best_iter": {k: int(v) for k, v in es.get("best_iter", {}).items()},
-        "key_order": list(es.get("key_order", [])),
-    }
-    # manifest LAST: its appearance commits the snapshot
-    atomic_write(manifest_path, json.dumps(manifest, indent=1))
+        es = getattr(gbdt, "_es_state", None) or {}
+        manifest = {
+            "version": MANIFEST_VERSION,
+            "iteration": int(iteration),
+            "num_trees": int(gbdt.num_trees()),
+            "num_tree_per_iteration": int(max(1, gbdt.num_tree_per_iteration)),
+            "init_score_value": float(gbdt.init_score_value),
+            "config_hash": config_hash(c),
+            "model_file": os.path.basename(model_path),
+            "model_size": len(model_text.encode()),
+            "model_sha256": _sha256_bytes(model_text.encode()),
+            "state_file": os.path.basename(state_path) if state else "",
+            "state_sha256": _sha256_file(state_path) if state else "",
+            "best_scores": dict(es.get("best_scores", {})),
+            "best_iter": {k: int(v) for k, v in es.get("best_iter", {}).items()},
+            "key_order": list(es.get("key_order", [])),
+        }
+        # manifest LAST: its appearance commits the snapshot
+        atomic_write(manifest_path, json.dumps(manifest, indent=1))
+        total_bytes = manifest["model_size"] + state_bytes
+        sp["bytes"] = total_bytes
+        counter_add("snapshot.writes")
+        counter_add("snapshot.bytes_written", total_bytes)
     log_info(f"saved snapshot to {model_path} (iteration {iteration})")
-    prune_snapshots(prefix, keep)
+    with span("snapshot.prune"):
+        prune_snapshots(prefix, keep)
     return model_path
 
 
@@ -168,6 +178,11 @@ def validate_snapshot(manifest_path: str) -> Optional[Dict]:
     resolved ``model_path``/``state_path``) or None when anything —
     missing file, truncation, checksum mismatch, unparsable JSON — is
     wrong."""
+    with span("snapshot.validate"):
+        return _validate_snapshot(manifest_path)
+
+
+def _validate_snapshot(manifest_path: str) -> Optional[Dict]:
     try:
         with open(manifest_path) as f:
             manifest = json.load(f)

@@ -69,6 +69,34 @@ def reset_parameter(**kwargs) -> Callable:
     return _callback
 
 
+def telemetry(record: Dict = None, trace_path: str = None) -> Callable:
+    """Per-iteration telemetry callback (see ``obs/telemetry.py``).
+
+    Enables the telemetry subsystem (optionally streaming the JSONL
+    trace to ``trace_path``), emits an ``iteration`` trace event per
+    boosting round carrying the eval results, and — when ``record`` is
+    given — keeps it refreshed with the live run summary
+    (``record["summary"]``), so a caller can watch counters and span
+    totals evolve mid-training.
+
+    Note: like every per-iteration callback, passing this disables the
+    fused multi-iteration block path; for block-speed runs set
+    ``telemetry_output`` in params (or ``LGBM_TPU_TRACE``) instead and
+    read ``obs.summary()`` after training."""
+    from . import obs
+    obs.enable(trace_path=trace_path)
+
+    def _callback(env: CallbackEnv) -> None:
+        fields = {"it": env.iteration}
+        for name, metric, val, _hib in (env.evaluation_result_list or []):
+            fields[f"{name}:{metric}"] = float(val)
+        obs.event("train", "iteration", **fields)
+        if record is not None:
+            record["summary"] = obs.summary()
+    _callback.order = 25
+    return _callback
+
+
 def early_stopping(stopping_rounds: int, verbose: bool = True) -> Callable:
     """Stop when no valid metric improves for `stopping_rounds` rounds
     (reference callback.py:142-215)."""

@@ -68,6 +68,13 @@ def run(argv: List[str]) -> int:
     params = parse_cli_args(argv)
     cfg = Config.from_params(params)
     set_verbosity(cfg.verbose)
+    if cfg.telemetry_output:
+        # telemetry_output=<path>: stream the JSONL event trace there
+        # (per-rank suffixed once the mesh is up) and write
+        # <path>.summary.json after training (rank-0 merged summary in
+        # multi-host runs) — README "Observability"
+        from . import obs
+        obs.enable(trace_path=cfg.telemetry_output)
     task = cfg.task
     if cfg.num_machines > 1:
         _init_network(cfg)
@@ -145,6 +152,26 @@ def _run_train(cfg: Config, params) -> None:
     if jax.process_index() == 0:    # every rank holds the identical model
         booster.save_model(cfg.output_model)
         log_info(f"finished training; model saved to {cfg.output_model}")
+    _write_telemetry_summary(cfg)
+
+
+def _write_telemetry_summary(cfg: Config) -> None:
+    """After a traced train: every rank's summary merged over the host
+    collective, written by rank 0 as ``<telemetry_output>.summary.json``
+    (single-host: this rank's summary, same file name)."""
+    if not cfg.telemetry_output:
+        return
+    from . import obs
+    import jax
+    merged = None
+    if jax.process_count() > 1:
+        from .io.distributed import jax_process_allgather
+        merged = obs.merged_summary(jax_process_allgather)
+        if jax.process_index() != 0:
+            return
+    path = cfg.telemetry_output + ".summary.json"
+    obs.write_summary(path, merged)
+    log_info(f"telemetry summary written to {path}")
 
 
 def _load_predict_input(cfg: Config):

@@ -142,7 +142,7 @@ PARAMETER_SET = frozenset({
     "gpu_use_dp", "convert_model", "convert_model_language",
     "feature_fraction_seed", "enable_bundle", "data_filename",
     "valid_data_filenames", "snapshot_freq", "snapshot_keep",
-    "resume_from", "sparse_threshold",
+    "resume_from", "sparse_threshold", "telemetry_output",
     "enable_load_from_binary_file", "max_conflict_rate", "histogram_pool_size",
     "is_provide_training_metric", "machines", "zero_as_missing",
     "init_score_file", "valid_init_score_file", "max_cat_threshold",
@@ -261,6 +261,10 @@ class Config:
     # newest valid snapshot under the output_model prefix)
     snapshot_keep: int = 2
     resume_from: str = ""
+    # observability: stream the telemetry JSONL trace to this path
+    # (per-rank suffixed in multi-host runs; see obs/telemetry.py and
+    # the LGBM_TPU_TRACE env equivalent)
+    telemetry_output: str = ""
 
     # dart
     drop_rate: float = 0.1

@@ -14,6 +14,7 @@ from typing import Any, Dict, List, Optional, Sequence
 import numpy as np
 
 from . import callback as callback_mod
+from . import obs
 from .basic import Booster, Dataset
 from .config import canonicalize_params
 from .utils.log import log_info, log_warning
@@ -38,8 +39,30 @@ def train(params: Dict[str, Any], train_set: Dataset,
     directory, or ``"auto"`` = the configured ``output_model`` prefix)
     and continue toward ``num_boost_round`` TOTAL iterations —
     bit-for-bit where the snapshot carries its score state (see
-    ``boosting/snapshot.py``)."""
+    ``boosting/snapshot.py``).
+
+    Telemetry: ``telemetry_output=<path>`` in ``params`` (or the
+    ``LGBM_TPU_TRACE`` env var) enables the structured telemetry
+    subsystem and streams its JSONL event trace there; the run summary
+    stays queryable via ``lightgbm_tpu.obs.summary()`` either way, and
+    the per-iteration ``callback.telemetry`` callback can snapshot it
+    during training (see README "Observability")."""
     params = canonicalize_params(dict(params or {}))
+    if params.get("telemetry_output"):
+        obs.enable(trace_path=str(params["telemetry_output"]))
+    with obs.span("engine.train"):
+        return _train(params, train_set, num_boost_round, valid_sets,
+                      valid_names, fobj, feval, init_model, feature_name,
+                      categorical_feature, early_stopping_rounds,
+                      evals_result, verbose_eval, learning_rates,
+                      keep_training_booster, callbacks, resume_from)
+
+
+def _train(params, train_set, num_boost_round, valid_sets, valid_names,
+           fobj, feval, init_model, feature_name, categorical_feature,
+           early_stopping_rounds, evals_result, verbose_eval,
+           learning_rates, keep_training_booster, callbacks,
+           resume_from) -> Booster:
     if resume_from is None and params.get("resume_from"):
         resume_from = str(params["resume_from"])
     if "num_iterations" in params:

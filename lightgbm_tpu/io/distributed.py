@@ -61,6 +61,7 @@ def jax_process_allgather(obj) -> List[object]:
     blip during a week-long run must not kill it); the
     ``collective.allgather`` fault point sits in front for the
     robustness tests."""
+    from ..obs import span
     from ..utils.faults import fault_point
     from ..utils.retry import retry_call
 
@@ -81,7 +82,10 @@ def jax_process_allgather(obj) -> List[object]:
         return [json.loads(bytes(g[r, :int(szs[r])]).decode())
                 for r in range(len(szs))]
 
-    return retry_call(_gather, what="collective.allgather")
+    # span around the WHOLE retried call: collective wall-clock in the
+    # run summary includes retries + backoff (what the run actually paid)
+    with span("collective.allgather"):
+        return retry_call(_gather, what="collective.allgather")
 
 
 class ExternalCollectives:
@@ -183,6 +187,7 @@ def find_bins_distributed(X_local: np.ndarray,
     a retried rank simply joins the collective late (the
     ThreadedAllgather barrier and the reference's blocking sockets both
     tolerate that)."""
+    from ..obs import span
     from ..utils.faults import fault_point
     from ..utils.retry import retrying
     inner = allgather
@@ -191,7 +196,14 @@ def find_bins_distributed(X_local: np.ndarray,
         fault_point("collective.allgather")
         return inner(obj)
 
-    allgather = retrying(_ag, what="collective.allgather")
+    _retry_ag = retrying(_ag, what="collective.allgather")
+
+    # distinct span name: with the jax backend injected the transport
+    # op times itself under "collective.allgather"; this one must not
+    # double-count into the same bucket
+    def allgather(obj):
+        with span("collective.binfind"):
+            return _retry_ag(obj)
     cat_set = set(int(c) for c in categorical_features)
     # 1. sync feature count to the min across ranks (:821)
     counts = allgather(int(X_local.shape[1]))

@@ -78,6 +78,12 @@ def parse_file(path: str, config: Config
                ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray],
                           Optional[np.ndarray], List[str], List[int]]:
     """-> (X, label, weight, query, feature_names, categorical_cols)."""
+    from ..obs import span
+    with span("io.parse_file", path=os.path.basename(path)):
+        return _parse_file(path, config)
+
+
+def _parse_file(path: str, config: Config):
     from ..utils.faults import fault_point
     from ..utils.retry import retry_call
 
@@ -451,6 +457,15 @@ def load_file(path: str, config: Config,
     finding runs distributed: feature-sharded quantiles over the local
     row shard, mappers allgathered so every rank bins identically
     (`dataset_loader.cpp:816-880`; see ``io/distributed.py``)."""
+    from ..obs import span
+    with span("io.load_file", path=os.path.basename(path)):
+        return _load_file(path, config, reference, rank, num_machines,
+                          allgather)
+
+
+def _load_file(path: str, config: Config,
+               reference: Optional[BinnedDataset],
+               rank: int, num_machines: int, allgather) -> BinnedDataset:
     bin_path = path + ".bin.npz"
     is_local = "://" not in path
     # the cache stores whatever one process binned — single-machine,

@@ -666,25 +666,31 @@ def make_phases_driver(data: DeviceData,
     update_jit = jax.jit(functools.partial(
         _apply_wave, A_out=A_tail, params=params, wave_cap=wave_cap))
 
+    # obs spans ride the same phase boundaries as the timetags: these
+    # dispatches are host-blocked (each done() waits on its outputs),
+    # so the span durations ARE device time for route (leaf routing) /
+    # hist (histogram build) / scan (split find) / update
+    from ..obs import span as obs_span
+
     def build(grad, hess, bag_mask=None, feature_mask=None) -> BuiltTree:
         state = init_jit(grad, hess, bag_mask)
         while True:
-            with tag("tree:route") as done:
+            with obs_span("tree.route"), tag("tree:route") as done:
                 leaf2 = route_jit(state)
                 done(leaf2)
             state = state._replace(leaf2=leaf2)
-            with tag("tree:hist") as done:
+            with obs_span("tree.hist"), tag("tree:hist") as done:
                 new_h = hist_jit(grad, hess, state)
                 done(new_h)
-            with tag("tree:scan") as done:
+            with obs_span("tree.split_find"), tag("tree:scan") as done:
                 hist_state, ids, res = scan_jit(state, new_h, feature_mask)
                 done(res.gain)
-            with tag("tree:update") as done:
+            with obs_span("tree.update"), tag("tree:update") as done:
                 state = update_jit(state, leaf2, hist_state, ids, res)
                 done(state.nl)
             if bool(state.done) or int(state.nl) >= L:
                 break
-        with tag("tree:route") as done:
+        with obs_span("tree.route"), tag("tree:route") as done:
             leaf2 = route_jit(state)
             done(leaf2)
         state = state._replace(leaf2=leaf2)

@@ -1,0 +1,18 @@
+"""Low-overhead training telemetry (see ``obs/telemetry.py``).
+
+Import seam for the rest of the library::
+
+    from ..obs import span, counter_add, event
+    with span("snapshot.write") as s:
+        ...
+        s["bytes"] = n
+"""
+from .telemetry import (counter_add, disable, enable, enabled, event,
+                        gauge_set, merged_summary, reset, span, summary,
+                        trace_path, write_summary)
+
+__all__ = [
+    "enabled", "enable", "disable", "reset", "span", "counter_add",
+    "gauge_set", "event", "summary", "merged_summary", "write_summary",
+    "trace_path",
+]

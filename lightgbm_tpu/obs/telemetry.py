@@ -1,0 +1,390 @@
+"""Structured training telemetry: spans, counters, gauges, JSONL traces.
+
+The reference prints only eval lines; on a TPU mesh that leaves every
+production question — where does wall-clock go (compile vs steady
+state, histogram vs split vs routing, collective vs compute), did the
+run degrade (kernel fallback, retries, injected faults), what did the
+snapshot machinery cost — unanswerable.  This module is the phase-level
+accounting the LightGBM paper used to justify its histogram design
+(Ke et al., NeurIPS 2017, Table 2), grown into a run-queryable
+subsystem:
+
+* **Spans** — ``with span("tree_build") as s: ...; s["bytes"] = n``.
+  Host-side wall-clock only, nestable (thread-local stack), NO implicit
+  device syncs: a span around an async JAX dispatch times the host cost
+  of that dispatch; callers that want device time must block first (the
+  jit-adjacent block-loop boundaries already do).
+* **Counters / gauges** — ``counter_add("retry.dispatch.retries")``,
+  ``gauge_set("hbm_bytes", n)``.  Counters accumulate (floats allowed:
+  backoff seconds ride the same channel), gauges overwrite.
+* **Events** — one-shot occurrences (``event("fault", name)``: an
+  injection fired, early stopping triggered).
+
+Sinks:
+
+* an in-memory **run summary** queryable as a plain dict
+  (:func:`summary`): per-span count/total/max seconds, counters,
+  gauges, event counts;
+* a **JSONL event trace**, enabled via ``LGBM_TPU_TRACE=<path>`` or the
+  ``telemetry_output`` config parameter.  Every record carries ``ts``
+  (wall-clock start, epoch seconds), ``kind`` (``span`` | ``counter`` |
+  ``gauge`` | ``event``), ``name``, and ``rank``; span records add
+  ``dur_s`` (>= 0), ``depth``, and ``parent`` — spans are written on
+  CLOSE, so a parent's record follows its children's;
+* **per-rank files** in multi-host runs (the trace path gains a
+  ``.rank<k>`` suffix, decided lazily at first write so enabling before
+  ``jax.distributed.initialize`` still lands per-rank) with a rank-0
+  **merged summary** over the existing host-collective path
+  (:func:`merged_summary` + ``io/distributed.jax_process_allgather``).
+
+Disabled telemetry is a guard-checked no-op — one module-attribute read
+per call site — so instrumentation stays compiled into every path,
+including per-iteration training loops and per-feature bin finding.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import threading
+import time
+from typing import Any, Dict, IO, Optional
+
+__all__ = [
+    "enabled", "enable", "disable", "reset", "span", "counter_add",
+    "gauge_set", "event", "summary", "merged_summary", "write_summary",
+    "trace_path",
+]
+
+_lock = threading.RLock()
+_tls = threading.local()            # per-thread span stack
+
+# -- state (module-level flags keep the disabled path one attribute read)
+_enabled = False
+_trace_requested: Optional[str] = None   # path asked for; file opens lazily
+_trace_file: Optional[IO[str]] = None
+_trace_open_path: Optional[str] = None
+
+_spans: Dict[str, list] = {}        # name -> [count, total_s, max_s]
+_counters: Dict[str, float] = {}
+_gauges: Dict[str, Any] = {}
+_events: Dict[str, int] = {}
+
+
+def _rank_world():
+    """(rank, world) without initializing any jax backend: reads the
+    distributed client state only when jax is already imported (the
+    same best-effort probe the CLI's already-meshed check uses)."""
+    jx = sys.modules.get("jax")
+    if jx is None:
+        return 0, 1
+    try:
+        from jax._src import distributed
+        st = distributed.global_state
+        if getattr(st, "client", None) is None:
+            return 0, 1
+        return int(st.process_id or 0), int(st.num_processes or 1)
+    except Exception:                   # noqa: BLE001 - probe is best-effort
+        return 0, 1
+
+
+# ---------------------------------------------------------------------------
+# lifecycle
+# ---------------------------------------------------------------------------
+def enabled() -> bool:
+    return _enabled
+
+
+def enable(trace_path: Optional[str] = None) -> None:
+    """Turn telemetry on.  ``trace_path`` additionally streams every
+    record as one JSON line (appended; per-rank suffix in multi-host
+    runs).  Idempotent; a second call can add a trace to an already
+    enabled run."""
+    global _enabled, _trace_requested
+    with _lock:
+        _enabled = True
+        if trace_path:
+            _trace_requested = trace_path
+
+
+def disable() -> None:
+    """Turn telemetry off (the accumulated summary is kept)."""
+    global _enabled, _trace_file, _trace_open_path
+    with _lock:
+        _enabled = False
+        if _trace_file is not None:
+            try:
+                _trace_file.close()
+            except OSError:
+                pass
+        _trace_file = None
+        _trace_open_path = None
+
+
+def reset() -> None:
+    """Clear the run summary and forget any requested trace (tests)."""
+    global _trace_requested, _held
+    with _lock:
+        disable()
+        _trace_requested = None
+        _held = None
+        _spans.clear()
+        _counters.clear()
+        _gauges.clear()
+        _events.clear()
+        if getattr(_tls, "stack", None):
+            _tls.stack = []
+
+
+def trace_path() -> Optional[str]:
+    """The trace file path actually written to (with any rank suffix),
+    or the requested path when nothing has been written yet."""
+    return _trace_open_path or _trace_requested
+
+
+def _init_from_env() -> None:
+    path = os.environ.get("LGBM_TPU_TRACE", "")
+    if path:
+        enable(trace_path=path)
+
+
+# ---------------------------------------------------------------------------
+# trace writing
+# ---------------------------------------------------------------------------
+_held = None                  # not None => buffer records instead of writing
+
+
+def hold_trace() -> None:
+    """Buffer trace records in memory instead of opening the trace
+    file.  Called around the multi-host rendezvous
+    (``parallel/mesh.init_distributed``): records emitted DURING the
+    rendezvous (its own retry counters) must not open the trace file
+    before the process knows its rank — every rank would grab the same
+    unsuffixed path.  No-op when already holding."""
+    global _held
+    with _lock:
+        if _held is None:
+            _held = []
+
+
+def release_trace() -> None:
+    """Flush records buffered by :func:`hold_trace` (their ``rank``
+    field is re-stamped — it was unknowable at emission) and resume
+    direct writes."""
+    global _held
+    with _lock:
+        pending, _held = _held, None
+        if pending:
+            rank, _ = _rank_world()
+            for rec in pending:
+                rec["rank"] = rank
+                _trace_write(rec)
+
+
+def _trace_write(record: Dict[str, Any]) -> None:
+    """Append one JSONL record.  Caller holds ``_lock``.  The file
+    opens lazily so multi-host runs that enable telemetry before
+    ``jax.distributed.initialize`` still get per-rank files."""
+    global _trace_file, _trace_open_path
+    if _held is not None:
+        _held.append(record)
+        return
+    if _trace_file is None:
+        if not _trace_requested:
+            return
+        rank, world = _rank_world()
+        path = _trace_requested
+        if world > 1:
+            path = f"{path}.rank{rank}"
+        try:
+            _trace_file = open(path, "a", buffering=1)
+            _trace_open_path = path
+        except OSError:
+            return
+    try:
+        _trace_file.write(json.dumps(record) + "\n")
+    except (OSError, ValueError):
+        pass
+
+
+# ---------------------------------------------------------------------------
+# spans
+# ---------------------------------------------------------------------------
+class _Discard:
+    """Attr sink for the disabled path: swallows writes, costs nothing."""
+    __slots__ = ()
+
+    def __setitem__(self, key, value):
+        pass
+
+    def update(self, *args, **kwargs):
+        pass
+
+
+_DISCARD = _Discard()
+
+
+class _NoopSpan:
+    __slots__ = ()
+
+    def __enter__(self):
+        return _DISCARD
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NOOP_SPAN = _NoopSpan()
+
+
+class _Span:
+    __slots__ = ("name", "attrs", "t0", "ts", "depth")
+
+    def __init__(self, name: str, attrs: Dict[str, Any]):
+        self.name = name
+        self.attrs = attrs
+
+    def __enter__(self):
+        stack = getattr(_tls, "stack", None)
+        if stack is None:
+            stack = _tls.stack = []
+        self.depth = len(stack)
+        stack.append(self.name)
+        self.ts = time.time()
+        self.t0 = time.perf_counter()
+        return self.attrs
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self.t0
+        stack = _tls.stack
+        parent = ""
+        if stack and stack[-1] is self.name:
+            stack.pop()
+            parent = stack[-1] if stack else ""
+        rank, _ = _rank_world()
+        with _lock:
+            agg = _spans.get(self.name)
+            if agg is None:
+                agg = _spans[self.name] = [0, 0.0, 0.0]
+            agg[0] += 1
+            agg[1] += dur
+            if dur > agg[2]:
+                agg[2] = dur
+            if _trace_requested:
+                rec = {"ts": self.ts, "kind": "span", "name": self.name,
+                       "rank": rank, "dur_s": dur, "depth": self.depth,
+                       "parent": parent}
+                if self.attrs:
+                    rec.update(self.attrs)
+                _trace_write(rec)
+        return False
+
+
+def span(name: str, **attrs):
+    """Context manager timing the enclosed block under ``name``; yields
+    a dict the block may add fields to (they land on the trace record).
+    A shared no-op when telemetry is disabled."""
+    if not _enabled:
+        return _NOOP_SPAN
+    return _Span(name, attrs)
+
+
+# ---------------------------------------------------------------------------
+# counters / gauges / events
+# ---------------------------------------------------------------------------
+def counter_add(name: str, n: float = 1) -> None:
+    if not _enabled:
+        return
+    rank, _ = _rank_world()
+    with _lock:
+        _counters[name] = _counters.get(name, 0) + n
+        if _trace_requested:
+            _trace_write({"ts": time.time(), "kind": "counter",
+                          "name": name, "rank": rank, "add": n,
+                          "value": _counters[name]})
+
+
+def gauge_set(name: str, value: Any) -> None:
+    if not _enabled:
+        return
+    rank, _ = _rank_world()
+    with _lock:
+        _gauges[name] = value
+        if _trace_requested:
+            _trace_write({"ts": time.time(), "kind": "gauge",
+                          "name": name, "rank": rank, "value": value})
+
+
+def event(kind: str, name: str, **fields) -> None:
+    """Record a one-shot occurrence.  ``kind`` is a coarse family
+    (``"fault"``, ``"early_stop"``, ...) kept distinct from the three
+    structural kinds; the trace record's ``kind`` field is ``"event"``
+    with the family under ``"family"``."""
+    if not _enabled:
+        return
+    rank, _ = _rank_world()
+    with _lock:
+        key = f"{kind}:{name}"
+        _events[key] = _events.get(key, 0) + 1
+        if _trace_requested:
+            rec = {"ts": time.time(), "kind": "event", "name": name,
+                   "rank": rank, "family": kind}
+            if fields:
+                rec.update(fields)
+            _trace_write(rec)
+
+
+# ---------------------------------------------------------------------------
+# summaries
+# ---------------------------------------------------------------------------
+def summary() -> Dict[str, Any]:
+    """The in-memory run summary as a plain (JSON-serializable) dict."""
+    rank, world = _rank_world()
+    with _lock:
+        return {
+            "rank": rank,
+            "process_count": world,
+            "spans": {k: {"count": v[0], "total_s": v[1], "max_s": v[2]}
+                      for k, v in _spans.items()},
+            "counters": dict(_counters),
+            "gauges": dict(_gauges),
+            "events": dict(_events),
+        }
+
+
+def merged_summary(allgather) -> Dict[str, Any]:
+    """Every rank's summary merged into one dict (identical on all
+    ranks — ``allgather`` is the host-collective seam, normally
+    ``io.distributed.jax_process_allgather``).  ``ranks`` keeps each
+    rank's full summary; ``counters``/``events`` sum and ``spans``
+    combine across ranks."""
+    locals_ = allgather(summary())
+    merged: Dict[str, Any] = {
+        "process_count": len(locals_),
+        "ranks": locals_,
+        "spans": {},
+        "counters": {},
+        "events": {},
+    }
+    for s in locals_:
+        for k, v in s.get("counters", {}).items():
+            merged["counters"][k] = merged["counters"].get(k, 0) + v
+        for k, v in s.get("events", {}).items():
+            merged["events"][k] = merged["events"].get(k, 0) + v
+        for k, v in s.get("spans", {}).items():
+            agg = merged["spans"].setdefault(
+                k, {"count": 0, "total_s": 0.0, "max_s": 0.0})
+            agg["count"] += v["count"]
+            agg["total_s"] += v["total_s"]
+            agg["max_s"] = max(agg["max_s"], v["max_s"])
+    return merged
+
+
+def write_summary(path: str, merged: Optional[Dict[str, Any]] = None) -> None:
+    """Atomically write a summary (merged or this rank's) as JSON."""
+    from ..utils.file_io import atomic_write
+    atomic_write(path, json.dumps(merged if merged is not None
+                                  else summary(), indent=1))
+
+
+_init_from_env()

@@ -101,10 +101,13 @@ def split_kernel_disabled() -> bool:
 def disable_split_kernel(reason: str = "") -> None:
     if not _DISABLED[0]:
         _DISABLED[0] = True
-        from ..utils.log import log_warning
-        log_warning("fused split kernel disabled for this process; "
-                    "falling back to the XLA scan path"
-                    + (f" ({reason})" if reason else ""))
+        from ..utils.log import log_once
+        # deduped: tests re-arm via enable_split_kernel and retried
+        # dispatches can re-trip this every block — one line per process
+        log_once("pallas_split.disabled",
+                 "fused split kernel disabled for this process; "
+                 "falling back to the XLA scan path"
+                 + (f" ({reason})" if reason else ""))
 
 
 def enable_split_kernel() -> None:

@@ -43,6 +43,7 @@ def init_distributed(coordinator_address: Optional[str] = None,
     if process_id is not None:
         kwargs["process_id"] = process_id
 
+    from ..obs import span
     from ..utils.faults import fault_point
     from ..utils.retry import RetryPolicy, retry_call
 
@@ -52,12 +53,21 @@ def init_distributed(coordinator_address: Optional[str] = None,
         fault_point("rendezvous.connect")
         jax.distributed.initialize(**kwargs)
 
+    from ..obs.telemetry import hold_trace, release_trace
     try:
         # retried with backoff: at pod startup the coordinator may come
         # up seconds after the workers (the reference's socket Connect
-        # loops with time_out retries, linkers_socket.cpp:225-274)
-        retry_call(_connect, policy=RetryPolicy.from_env(),
-                   what="rendezvous.connect")
+        # loops with time_out retries, linkers_socket.cpp:225-274).
+        # Trace records buffer until the rendezvous resolves this
+        # process's rank — the per-rank trace file must not open as
+        # rank 0 on every worker.
+        hold_trace()
+        try:
+            with span("mesh.rendezvous"):
+                retry_call(_connect, policy=RetryPolicy.from_env(),
+                           what="rendezvous.connect")
+        finally:
+            release_trace()
     except RuntimeError as exc:
         # idempotent entry: the CLI's already-meshed probe reads private
         # jax state and may miss on a future jax — double-initialize

@@ -142,6 +142,9 @@ def fault_point(name: str) -> None:
         arm.times -= 1
         _fired[name] = _fired.get(name, 0) + 1
         transient = arm.transient
+    from ..obs import counter_add, event
+    counter_add(f"faults.{name}.fired")
+    event("fault", name, transient=transient)
     raise FaultInjected(name, transient=transient)
 
 

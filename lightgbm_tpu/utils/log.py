@@ -4,10 +4,21 @@ The reference has a static ``Log`` class with Fatal/Warning/Info/Debug levels
 driven by the ``verbosity`` parameter plus CHECK macros.  Here we route through
 the stdlib logging module under the ``lightgbm_tpu`` logger, keeping the same
 level semantics (verbose<0: fatal only, 0: +warning, 1: +info, >1: +debug).
+
+Multi-host: every line is prefixed ``[rank k/N]`` when the process is part
+of an initialized ``jax.distributed`` mesh (N > 1) — interleaved worker
+logs are unreadable without it.  The rank probe never initializes a jax
+backend (it only reads state when jax is already imported and meshed).
+
+``log_once(key, msg)`` dedupes repeating warnings (e.g. a per-dispatch
+kernel-fallback notice) to one line per process per key.
 """
 from __future__ import annotations
 
 import logging
+import sys
+import threading
+from typing import Set
 
 _logger = logging.getLogger("lightgbm_tpu")
 if not _logger.handlers:
@@ -15,6 +26,30 @@ if not _logger.handlers:
     _handler.setFormatter(logging.Formatter("[LightGBM-TPU] [%(levelname)s] %(message)s"))
     _logger.addHandler(_handler)
     _logger.setLevel(logging.INFO)
+
+_once_lock = threading.Lock()
+_once_seen: Set[str] = set()
+
+
+def _rank_prefix() -> str:
+    """``"[rank k/N] "`` when part of a multi-process mesh, else ``""``.
+    Best-effort: reads jax's distributed client state WITHOUT importing
+    jax (which would pay backend init in pure-host tools) and without
+    initializing anything (``jax.process_count()`` would)."""
+    jx = sys.modules.get("jax")
+    if jx is None:
+        return ""
+    try:
+        from jax._src import distributed
+        st = distributed.global_state
+        if getattr(st, "client", None) is None:
+            return ""
+        world = int(st.num_processes or 1)
+        if world <= 1:
+            return ""
+        return f"[rank {int(st.process_id or 0)}/{world}] "
+    except Exception:                   # noqa: BLE001 - probe is best-effort
+        return ""
 
 
 def set_verbosity(verbose: int) -> None:
@@ -29,20 +64,40 @@ def set_verbosity(verbose: int) -> None:
 
 
 def log_fatal(msg: str) -> None:
-    _logger.critical(msg)
+    _logger.critical(_rank_prefix() + msg)
     raise RuntimeError(msg)
 
 
 def log_warning(msg: str) -> None:
-    _logger.warning(msg)
+    _logger.warning(_rank_prefix() + msg)
 
 
 def log_info(msg: str) -> None:
-    _logger.info(msg)
+    _logger.info(_rank_prefix() + msg)
 
 
 def log_debug(msg: str) -> None:
-    _logger.debug(msg)
+    _logger.debug(_rank_prefix() + msg)
+
+
+def log_once(key: str, msg: str, level: str = "warning") -> bool:
+    """Log ``msg`` at ``level`` the FIRST time ``key`` is seen in this
+    process; later calls with the same key are dropped.  Returns whether
+    the line was emitted.  For warnings that a hot path can re-trigger
+    every dispatch (the pallas_split disable notice)."""
+    with _once_lock:
+        if key in _once_seen:
+            return False
+        _once_seen.add(key)
+    {"warning": log_warning, "info": log_info,
+     "debug": log_debug}.get(level, log_warning)(msg)
+    return True
+
+
+def reset_log_once() -> None:
+    """Forget dedupe state (tests)."""
+    with _once_lock:
+        _once_seen.clear()
 
 
 def check(cond: bool, msg: str = "") -> None:

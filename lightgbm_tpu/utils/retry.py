@@ -31,6 +31,7 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Optional, Tuple
 
+from ..obs import counter_add
 from .log import log_warning
 
 # NOTE: no RESOURCE_EXHAUSTED — see module docstring
@@ -99,13 +100,23 @@ def retry_call(fn: Callable, *args,
     exponential backoff until the attempt count or deadline runs out.
     Non-retryable exceptions propagate immediately; on exhaustion the
     LAST retryable exception is re-raised (the caller sees the real
-    fault, not a wrapper)."""
+    fault, not a wrapper).
+
+    Every attempt increments the per-site telemetry counters
+    (``retry.<site>.attempts`` / ``.retries`` / ``.backoff_s`` and a
+    final ``.recovered`` or ``.exhausted``), and every retry is logged
+    at WARNING with the site name, attempt number, and backoff sleep —
+    a preempted-and-recovered run must look different from a clean one."""
     p = policy or RetryPolicy.from_env()
     t0 = time.monotonic()
     last: Optional[BaseException] = None
     for attempt in range(max(1, p.attempts)):
+        counter_add(f"retry.{what}.attempts")
         try:
-            return fn(*args, **kwargs)
+            out = fn(*args, **kwargs)
+            if attempt > 0:
+                counter_add(f"retry.{what}.recovered")
+            return out
         except Exception as exc:        # noqa: BLE001 - filtered below
             if not retryable(exc):
                 raise
@@ -125,7 +136,10 @@ def retry_call(fn: Callable, *args,
                     f"transient failure in {what} (attempt "
                     f"{attempt + 1}/{p.attempts}), retrying in "
                     f"{s:.1f}s: {str(exc)[:200]}")
+                counter_add(f"retry.{what}.retries")
+                counter_add(f"retry.{what}.backoff_s", s)
                 _sleep(s)
+    counter_add(f"retry.{what}.exhausted")
     raise last
 
 

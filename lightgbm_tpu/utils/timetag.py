@@ -102,4 +102,5 @@ def _ensure_report() -> None:
     global _registered
     if not _registered:
         _registered = True
-        atexit.register(lambda: print(report()))
+        from .log import log_info
+        atexit.register(lambda: log_info(report()))

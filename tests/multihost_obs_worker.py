@@ -1,0 +1,93 @@
+"""Worker for the 2-process telemetry merge test (run by
+``tests/test_multihost.py``, one subprocess per rank).
+
+Exercises the multi-host telemetry contract end-to-end: per-rank JSONL
+trace files (the ``.rank<k>`` suffix decided lazily at first write,
+AFTER the mesh is up), collective spans + retry counters populated by a
+fault-injected-then-retried ``jax_process_allgather``, and the rank-0
+merged summary over the same host-collective path — it must contain
+BOTH ranks' collective timings and retry counters.
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+# fast retries: the injected collective fault must not cost the test
+# the default 1 s backoff
+os.environ["LGBM_TPU_RETRY_BASE_S"] = "0.01"
+os.environ["LGBM_TPU_RETRY_JITTER"] = "0"
+
+
+def main():
+    rank = int(sys.argv[1])
+    port = sys.argv[2]
+    out_dir = sys.argv[3]
+    world = 2
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.io.distributed import jax_process_allgather
+    from lightgbm_tpu.parallel.mesh import init_distributed
+    from lightgbm_tpu.utils import faults
+
+    trace_base = os.path.join(out_dir, "trace.jsonl")
+    obs.enable(trace_path=trace_base)
+
+    init_distributed(f"localhost:{port}", num_processes=world,
+                     process_id=rank)
+    assert jax.process_count() == world, jax.process_count()
+
+    # one injected DCN blip per rank: the retry layer recovers it and the
+    # telemetry counters must show the attempt/retry/recovery.  The fault
+    # fires BEFORE any rank-synchronization state, so a retried rank
+    # simply joins the collective late (see io/distributed.py).
+    faults.inject("collective.allgather", times=1)
+    gathered = jax_process_allgather({"rank": rank})
+    assert [g["rank"] for g in gathered] == [0, 1], gathered
+    faults.clear()
+
+    local = obs.summary()
+    assert local["process_count"] == world
+    assert local["rank"] == rank
+    assert local["spans"]["collective.allgather"]["count"] >= 1
+    assert local["counters"]["retry.collective.allgather.retries"] >= 1
+    assert local["counters"]["faults.collective.allgather.fired"] == 1
+
+    merged = obs.merged_summary(jax_process_allgather)
+    assert merged["process_count"] == world
+    for r in range(world):
+        rs = merged["ranks"][r]
+        assert rs["rank"] == r, rs["rank"]
+        # both ranks' collective timings ...
+        assert rs["spans"]["collective.allgather"]["total_s"] > 0
+        # ... and retry counters survive the merge
+        assert rs["counters"]["retry.collective.allgather.retries"] >= 1
+    assert merged["counters"]["retry.collective.allgather.retries"] >= world
+    assert merged["spans"]["collective.allgather"]["count"] >= world
+
+    if rank == 0:
+        obs.write_summary(trace_base + ".summary.json", merged)
+    obs.disable()
+
+    # per-rank trace file with schema-complete records carrying the rank
+    rank_path = f"{trace_base}.rank{rank}"
+    assert os.path.exists(rank_path), rank_path
+    with open(rank_path) as f:
+        records = [json.loads(line) for line in f if line.strip()]
+    assert records, "empty per-rank trace"
+    for rec in records:
+        assert {"ts", "kind", "name", "rank"} <= set(rec), rec
+        assert rec["rank"] == rank, rec
+    assert any(rec["name"] == "collective.allgather" and rec["kind"] == "span"
+               for rec in records)
+
+    print(f"OBS_MULTIHOST_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()

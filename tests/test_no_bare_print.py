@@ -1,0 +1,52 @@
+"""CI guard: no bare ``print(`` in library code.
+
+All library output must route through ``utils/log.py`` (leveled,
+rank-prefixed, verbosity-controlled) or ``obs/`` (structured telemetry)
+so multi-host runs stay readable and ``verbose=-1`` actually silences
+the library.  Allowed exceptions: ``cli.py`` (its usage text is the
+program's stdout contract) and ``plotting.py`` (interactive helper).
+"""
+import os
+import re
+
+ALLOWED = {"cli.py", "plotting.py"}
+# a real call: `print(` not preceded by a word char, dot (method call
+# like pprint.pprint), or `def `; comments and docstring mentions are
+# filtered line-wise below
+_PRINT_RE = re.compile(r"(?<![\w.])print\(")
+
+
+def test_no_bare_print_in_library():
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "lightgbm_tpu")
+    offenders = []
+    for root, _dirs, files in os.walk(pkg):
+        for name in files:
+            if not name.endswith(".py") or name in ALLOWED:
+                continue
+            path = os.path.join(root, name)
+            in_doc = None
+            with open(path) as f:
+                for lineno, line in enumerate(f, 1):
+                    stripped = line.strip()
+                    # crude but sufficient docstring/comment filter for
+                    # this codebase's style (no print( inside either)
+                    if stripped.startswith("#"):
+                        continue
+                    for quote in ('"""', "'''"):
+                        if in_doc is None and stripped.count(quote) == 1 \
+                                and stripped.startswith(quote):
+                            in_doc = quote
+                            break
+                        if in_doc == quote and quote in stripped:
+                            in_doc = None
+                            break
+                    else:
+                        if in_doc is None and _PRINT_RE.search(
+                                line.split("#", 1)[0]):
+                            offenders.append(
+                                f"{os.path.relpath(path, pkg)}:{lineno}: "
+                                f"{stripped}")
+    assert not offenders, (
+        "bare print( in library code (route through utils/log.py or "
+        "obs/):\n" + "\n".join(offenders))

@@ -1,0 +1,92 @@
+#!/usr/bin/env python
+"""Render a phase-breakdown table from a telemetry JSONL trace.
+
+Usage::
+
+    python tools/telemetry_report.py /tmp/run.jsonl [more.jsonl ...]
+
+Reads trace files written via ``LGBM_TPU_TRACE=<path>`` or the
+``telemetry_output`` config parameter (multi-host runs write one
+``<path>.rank<k>`` file per rank — pass them all to merge).  Prints:
+
+* per-span phase breakdown (count, total seconds, share of the summed
+  span time at that nesting depth, max single duration),
+* counters (retry attempts/backoff, snapshot bytes, compile counts...),
+* one-shot events (faults fired, early stopping).
+
+The share column uses DEPTH-0 spans as the denominator: nested spans
+(e.g. ``gbdt.block`` inside ``gbdt.train`` inside ``engine.train``)
+would otherwise double-count wall-clock.  See README "Observability"
+for the event schema.
+"""
+import json
+import sys
+from collections import defaultdict
+
+
+def load_records(paths):
+    records = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    records.append(json.loads(line))
+    return records
+
+
+def report(records, out=sys.stdout):
+    spans = defaultdict(lambda: [0, 0.0, 0.0, 0])   # count,total,max,min_depth
+    counters = {}
+    events = defaultdict(int)
+    ranks = set()
+    for r in records:
+        ranks.add(r.get("rank", 0))
+        kind = r.get("kind")
+        if kind == "span":
+            agg = spans[r["name"]]
+            agg[0] += 1
+            agg[1] += r.get("dur_s", 0.0)
+            agg[2] = max(agg[2], r.get("dur_s", 0.0))
+            agg[3] = min(agg[3], r.get("depth", 0)) if agg[0] > 1 \
+                else r.get("depth", 0)
+        elif kind == "counter":
+            counters[r["name"]] = r.get("value", 0)
+        elif kind == "event":
+            events[f'{r.get("family", "event")}:{r["name"]}'] += 1
+
+    wall = sum(v[1] for v in spans.values() if v[3] == 0) or 1.0
+    print(f"ranks: {sorted(ranks)}    depth-0 span time: {wall:.3f}s",
+          file=out)
+    print(f"\n{'phase':<28s} {'count':>7s} {'total_s':>10s} "
+          f"{'share':>7s} {'max_s':>9s}", file=out)
+    print("-" * 64, file=out)
+    for name, (cnt, total, mx, depth) in sorted(
+            spans.items(), key=lambda kv: -kv[1][1]):
+        share = f"{100.0 * total / wall:5.1f}%" if depth == 0 else "     -"
+        indent = "  " * depth
+        print(f"{indent + name:<28s} {cnt:>7d} {total:>10.3f} "
+              f"{share:>7s} {mx:>9.3f}", file=out)
+    if counters:
+        print("\ncounters:", file=out)
+        for name in sorted(counters):
+            v = counters[name]
+            v = f"{v:.3f}" if isinstance(v, float) and v != int(v) \
+                else f"{int(v)}"
+            print(f"  {name:<40s} {v:>12s}", file=out)
+    if events:
+        print("\nevents:", file=out)
+        for name in sorted(events):
+            print(f"  {name:<40s} {events[name]:>12d}", file=out)
+
+
+def main(argv):
+    if not argv:
+        print(__doc__)
+        return 1
+    report(load_records(argv))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))
