@@ -58,6 +58,11 @@ def _effort_opt_supported() -> bool:
             _EFFORT_OPT_OK = True
         except Exception:               # noqa: BLE001 - any failure:
             _EFFORT_OPT_OK = False      # fall back to default effort
+            from ..utils.log import log_once
+            log_once("effort_opt_unsupported",
+                     "compiler exec_time_optimization_effort not "
+                     "supported by this jax/XLA; using default effort",
+                     level="info")
     return _EFFORT_OPT_OK
 
 
@@ -1234,6 +1239,7 @@ class GBDT:
                 continue
             try:
                 nl.copy_to_host_async()
+            # tpulint: disable=TPL006 -- prefetch-only; sync fetch follows
             except Exception:              # noqa: BLE001 - CPU backends
                 pass
             if prev_check is not None:
@@ -1266,9 +1272,21 @@ class GBDT:
     def train(self, num_iterations: Optional[int] = None,
               callbacks: Sequence = ()) -> None:
         """Full training loop with early stopping + snapshots
-        (reference GBDT::Train gbdt.cpp:309-327 + Application::Train)."""
-        with obs_span("gbdt.train"):
-            self._train(num_iterations, callbacks)
+        (reference GBDT::Train gbdt.cpp:309-327 + Application::Train).
+
+        Under ``LGBM_TPU_TRACE_CONTRACT=1`` the whole loop runs inside a
+        :class:`~lightgbm_tpu.obs.trace_contract.CompileTracker`: the
+        first window is warmup, everything after must hit the trace
+        cache — the report lands in the telemetry summary's
+        ``trace_contract`` section (background block-length upgrades
+        are counted separately, not as violations)."""
+        from ..obs.trace_contract import maybe_track
+        with obs_span("gbdt.train"), maybe_track() as tracker:
+            self._trace_tracker = tracker
+            try:
+                self._train(num_iterations, callbacks)
+            finally:
+                self._trace_tracker = None
         from ..obs import enabled as obs_enabled, gauge_set
         if obs_enabled():
             gauge_set("gbdt.iterations", int(self.iter))
@@ -1316,6 +1334,10 @@ class GBDT:
             else:
                 stop = self.train_one_iter()
                 it += 1
+            # first window done == warmup over (idempotent; see train())
+            tracker = getattr(self, "_trace_tracker", None)
+            if tracker is not None:
+                tracker.mark_steady()
             if stop:
                 break
             if want_eval and eval_freq > 0 and it % eval_freq == 0:

@@ -97,8 +97,12 @@ def _load() -> Optional[ctypes.CDLL]:
             ctypes.POINTER(ctypes.c_double)]
         lib.ltpu_free.argtypes = [ctypes.POINTER(ctypes.c_double)]
         _lib = lib
-    except Exception:
+    except Exception:               # noqa: BLE001 - optional accelerator
         _lib = None
+        from ..utils.log import log_once
+        log_once("native.unavailable",
+                 "native C parser library unavailable; using the "
+                 "pure-python loader", level="debug")
     return _lib
 
 

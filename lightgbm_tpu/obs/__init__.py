@@ -8,11 +8,11 @@ Import seam for the rest of the library::
         s["bytes"] = n
 """
 from .telemetry import (counter_add, disable, enable, enabled, event,
-                        gauge_set, merged_summary, reset, span, summary,
-                        trace_path, write_summary)
+                        gauge_set, merged_summary, reset, set_section,
+                        span, summary, trace_path, write_summary)
 
 __all__ = [
     "enabled", "enable", "disable", "reset", "span", "counter_add",
     "gauge_set", "event", "summary", "merged_summary", "write_summary",
-    "trace_path",
+    "trace_path", "set_section",
 ]

@@ -53,7 +53,7 @@ from typing import Any, Dict, IO, Optional
 __all__ = [
     "enabled", "enable", "disable", "reset", "span", "counter_add",
     "gauge_set", "event", "summary", "merged_summary", "write_summary",
-    "trace_path",
+    "trace_path", "set_section",
 ]
 
 _lock = threading.RLock()
@@ -69,6 +69,11 @@ _spans: Dict[str, list] = {}        # name -> [count, total_s, max_s]
 _counters: Dict[str, float] = {}
 _gauges: Dict[str, Any] = {}
 _events: Dict[str, int] = {}
+# named summary sections (e.g. "trace_contract"): written by subsystems
+# that produce one structured result per run rather than a stream;
+# stored even while telemetry is disabled — a contract check the user
+# explicitly enabled must not vanish because tracing is off
+_sections: Dict[str, Any] = {}
 
 
 def _rank_world():
@@ -84,6 +89,7 @@ def _rank_world():
         if getattr(st, "client", None) is None:
             return 0, 1
         return int(st.process_id or 0), int(st.num_processes or 1)
+    # tpulint: disable=TPL006 -- best-effort probe of private jax state
     except Exception:                   # noqa: BLE001 - probe is best-effort
         return 0, 1
 
@@ -132,6 +138,7 @@ def reset() -> None:
         _counters.clear()
         _gauges.clear()
         _events.clear()
+        _sections.clear()
         if getattr(_tls, "stack", None):
             _tls.stack = []
 
@@ -337,11 +344,20 @@ def event(kind: str, name: str, **fields) -> None:
 # ---------------------------------------------------------------------------
 # summaries
 # ---------------------------------------------------------------------------
+def set_section(name: str, data: Any) -> None:
+    """Attach a named section to the run summary (overwrites).  Unlike
+    counters/spans this is NOT gated on :func:`enabled` — sections are
+    one-shot structured results (the trace-contract report) whose
+    producers gate themselves."""
+    with _lock:
+        _sections[name] = data
+
+
 def summary() -> Dict[str, Any]:
     """The in-memory run summary as a plain (JSON-serializable) dict."""
     rank, world = _rank_world()
     with _lock:
-        return {
+        out = {
             "rank": rank,
             "process_count": world,
             "spans": {k: {"count": v[0], "total_s": v[1], "max_s": v[2]}
@@ -350,6 +366,8 @@ def summary() -> Dict[str, Any]:
             "gauges": dict(_gauges),
             "events": dict(_events),
         }
+        out.update(_sections)
+        return out
 
 
 def merged_summary(allgather) -> Dict[str, Any]:

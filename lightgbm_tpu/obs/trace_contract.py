@@ -1,0 +1,192 @@
+"""Runtime trace contract: zero recompiles after the warmup block.
+
+The static linter (``tools/tpulint``) catches hazard PATTERNS; this
+module checks the actual property they threaten — that the steady-state
+training loop never re-enters XLA.  A recompile mid-run is either a
+shape instability (a Python scalar that should be static, a
+data-dependent pad) or a cache-key bug, and on a remote TPU it costs
+10-30 s per occurrence while looking exactly like a slow iteration.
+
+Mechanism: ``jax_log_compiles`` makes jax's lowering path log one
+``Compiling <name> ...`` record per trace-cache miss
+(``jax._src.interpreters.pxla``); :class:`CompileTracker` attaches a
+logging handler, splits the stream at :meth:`mark_steady` (the caller
+flags the end of warmup — ``GBDT._train`` does so after its first
+window), and reports warmup vs steady counts.  Background AOT compiles
+(``GBDT._spawn_block_compile`` upgrading a borrowed block length) are
+deliberate steady-state compiles on a worker thread — the tracker
+records the originating thread and excludes non-tracked threads from
+the contract by default.
+
+Wiring: ``LGBM_TPU_TRACE_CONTRACT=1`` makes ``GBDT.train`` run under a
+tracker and feed a ``trace_contract`` section into the telemetry
+summary (``obs.summary()["trace_contract"]``); a violation also emits a
+``contract:recompile_after_warmup`` event and a WARNING log.
+``tests/test_tpulint.py`` asserts the tier-1 training path reports
+zero steady compiles.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Any, Dict, List, Optional
+
+from ..utils.log import log_warning
+from . import telemetry
+
+_COMPILE_LOGGERS = (
+    "jax._src.interpreters.pxla",     # "Compiling <name> with global shapes"
+    "jax._src.dispatch",              # older jax variants log here
+)
+_COMPILE_PREFIX = "Compiling "
+
+ENV_FLAG = "LGBM_TPU_TRACE_CONTRACT"
+
+
+def contract_enabled() -> bool:
+    return bool(os.environ.get(ENV_FLAG, ""))
+
+
+class _Handler(logging.Handler):
+    def __init__(self, tracker: "CompileTracker"):
+        super().__init__(level=logging.DEBUG)
+        self._tracker = tracker
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        # tpulint: disable=TPL006 -- logging.Handler.emit must not raise
+        except Exception:               # noqa: BLE001 - malformed record
+            return
+        if msg.startswith(_COMPILE_PREFIX):
+            self._tracker._record(msg, record.thread)
+
+
+class CompileTracker:
+    """Counts XLA trace-cache misses, split into warmup vs steady at
+    :meth:`mark_steady`.  Context manager; re-entrant use is not
+    supported (one tracker per training run)."""
+
+    def __init__(self, track_threads: bool = True):
+        self._handler = _Handler(self)
+        self._events: List[Dict[str, Any]] = []
+        self._steady_idx: Optional[int] = None
+        self._lock = threading.Lock()
+        self._track_threads = track_threads
+        self._main_thread: Optional[int] = None
+        self._prev_flag: Optional[bool] = None
+        self._prev_levels: Dict[str, int] = {}
+        self._prev_propagate: Dict[str, bool] = {}
+
+    # -- lifecycle ------------------------------------------------------
+    def __enter__(self) -> "CompileTracker":
+        import jax
+        self._main_thread = threading.get_ident()
+        self._prev_flag = bool(jax.config.jax_log_compiles)
+        jax.config.update("jax_log_compiles", True)
+        for name in _COMPILE_LOGGERS:
+            lg = logging.getLogger(name)
+            self._prev_levels[name] = lg.level
+            self._prev_propagate[name] = lg.propagate
+            if lg.level > logging.WARNING or lg.level == logging.NOTSET:
+                lg.setLevel(logging.WARNING)
+            # jax's stderr handler sits on the parent "jax" logger;
+            # stop propagation so enabling jax_log_compiles for the
+            # tracker doesn't spam the user's console
+            lg.propagate = False
+            lg.addHandler(self._handler)
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        import jax
+        for name in _COMPILE_LOGGERS:
+            lg = logging.getLogger(name)
+            lg.removeHandler(self._handler)
+            lg.setLevel(self._prev_levels.get(name, logging.NOTSET))
+            lg.propagate = self._prev_propagate.get(name, True)
+        if self._prev_flag is not None:
+            jax.config.update("jax_log_compiles", self._prev_flag)
+        return False
+
+    # -- recording ------------------------------------------------------
+    def _record(self, msg: str, thread: Optional[int]) -> None:
+        # "Compiling <name> with global shapes and types [...]" -> <name>
+        name = msg[len(_COMPILE_PREFIX):].split(" ", 1)[0]
+        with self._lock:
+            self._events.append({"name": name, "thread": thread})
+
+    def mark_steady(self) -> None:
+        """Flag the end of warmup; idempotent — the FIRST call wins (a
+        per-window caller can invoke it unconditionally)."""
+        with self._lock:
+            if self._steady_idx is None:
+                self._steady_idx = len(self._events)
+
+    # -- reporting ------------------------------------------------------
+    def _split(self):
+        with self._lock:
+            cut = (self._steady_idx if self._steady_idx is not None
+                   else len(self._events))
+            warm, steady = self._events[:cut], self._events[cut:]
+        if self._track_threads:
+            background = [e for e in steady
+                          if e["thread"] != self._main_thread]
+            steady = [e for e in steady
+                      if e["thread"] == self._main_thread]
+        else:
+            background = []
+        return warm, steady, background
+
+    def report(self) -> Dict[str, Any]:
+        warm, steady, background = self._split()
+        return {
+            "compiles_warmup": len(warm),
+            "compiles_steady": len(steady),
+            "compiles_background": len(background),
+            "steady_ok": not steady,
+            "steady_names": sorted({e["name"] for e in steady}),
+        }
+
+
+class _NoTracker:
+    """Shared no-op so call sites stay unconditional."""
+
+    def mark_steady(self) -> None:
+        pass
+
+
+_NO_TRACKER = _NoTracker()
+
+
+class maybe_track:
+    """``with maybe_track() as t:`` — a live :class:`CompileTracker`
+    when ``LGBM_TPU_TRACE_CONTRACT`` is set, else a no-op.  On exit of
+    a live tracker the report lands in the telemetry summary's
+    ``trace_contract`` section; a violation logs and emits an event."""
+
+    def __init__(self) -> None:
+        self._tracker: Optional[CompileTracker] = None
+
+    def __enter__(self):
+        if not contract_enabled():
+            return _NO_TRACKER
+        self._tracker = CompileTracker().__enter__()
+        return self._tracker
+
+    def __exit__(self, *exc) -> bool:
+        if self._tracker is None:
+            return False
+        self._tracker.__exit__(*exc)
+        rep = self._tracker.report()
+        telemetry.set_section("trace_contract", rep)
+        if not rep["steady_ok"]:
+            telemetry.event("contract", "recompile_after_warmup",
+                            count=rep["compiles_steady"],
+                            names=rep["steady_names"])
+            log_warning(
+                f"trace contract violated: {rep['compiles_steady']} "
+                f"recompile(s) after warmup "
+                f"({', '.join(rep['steady_names'][:5])}) — a shape/"
+                f"static-arg instability is re-entering XLA every run")
+        return False

@@ -34,5 +34,9 @@ def enable_default_compile_cache() -> None:
         # cache even fast compiles: the block program's cost is the sum
         # of many medium-sized waves
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-    except Exception:                   # noqa: BLE001 - cache is best-effort
-        pass
+    except Exception as exc:            # noqa: BLE001 - cache is best-effort
+        from .log import log_once
+        log_once("compile_cache.disabled",
+                 f"persistent compile cache unavailable ({exc}); "
+                 f"compiles will not be reused across processes",
+                 level="debug")

@@ -48,6 +48,7 @@ def _rank_prefix() -> str:
         if world <= 1:
             return ""
         return f"[rank {int(st.process_id or 0)}/{world}] "
+    # tpulint: disable=TPL006 -- the logger cannot log its own probe
     except Exception:                   # noqa: BLE001 - probe is best-effort
         return ""
 

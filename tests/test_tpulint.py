@@ -1,0 +1,233 @@
+"""Tier-1 gate: tpulint static analysis + the runtime trace contract.
+
+Three layers:
+
+1. **Package gate** — ``lightgbm_tpu/`` must be clean against the
+   committed baseline (``tools/tpulint/baseline.json``); seeding any
+   fixture hazard into a library module flips this red with the rule id
+   and file:line (proved by the seeded-copy test below).
+2. **Rule correctness** — every fixture under ``tpulint_fixtures/``
+   carries ``# EXPECT: TPLxxx`` / ``# EXPECT-NEXT: TPLxxx`` markers;
+   the linter must report EXACTLY the marked (line, rule) pairs.
+   TPL005/TPL008 are project-level rules exercised against temp roots.
+3. **Trace contract** — a real (tiny) training run under
+   ``LGBM_TPU_TRACE_CONTRACT=1`` must report zero post-warmup
+   recompiles in the telemetry summary, and the tracker must catch an
+   intentionally shape-unstable jit function.
+"""
+import json
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "tpulint_fixtures")
+
+from tools.tpulint import (BASELINE_DEFAULT, load_baseline,  # noqa: E402
+                           new_findings, run_lint, write_baseline)
+
+_EXPECT_RE = re.compile(
+    r"#\s*EXPECT(-NEXT)?:\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)")
+
+
+def _markers(path):
+    """{(lineno, rule)} expected findings declared in a fixture."""
+    out = set()
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            m = _EXPECT_RE.search(line)
+            if not m:
+                continue
+            target = lineno + 1 if m.group(1) else lineno
+            for rule in m.group(2).split(","):
+                out.add((target, rule.strip()))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. package gate
+# ---------------------------------------------------------------------------
+def test_package_clean_vs_baseline():
+    findings, by_rel = run_lint(["lightgbm_tpu"], root=REPO)
+    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    fresh = new_findings(findings, by_rel, baseline)
+    assert not fresh, ("new tpulint findings (fix, suppress with "
+                       "justification, or --update-baseline):\n"
+                       + "\n".join(f.render() for f in fresh))
+
+
+def test_seeded_hazard_fails_gate(tmp_path):
+    """Acceptance: seeding one fixture hazard into a library module
+    makes the gate fail with the right rule id and file:line."""
+    pkg = tmp_path / "lightgbm_tpu"
+    shutil.copytree(os.path.join(REPO, "lightgbm_tpu"), pkg,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    target = pkg / "models" / "tree.py"
+    base_lines = len(target.read_text().splitlines())
+    target.write_text(target.read_text() + (
+        "\n\nimport jax as _probe_jax\n\n\n"
+        "@_probe_jax.jit\n"
+        "def _tpulint_probe(x):\n"
+        "    return x.sum().item()\n"))
+    hazard_line = base_lines + 8
+    findings, by_rel = run_lint(["lightgbm_tpu"], root=str(tmp_path),
+                                project_rules=False)
+    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    fresh = new_findings(findings, by_rel, baseline)
+    assert any(f.rule == "TPL001"
+               and f.file == "lightgbm_tpu/models/tree.py"
+               and f.line == hazard_line for f in fresh), \
+        [f.render() for f in fresh]
+
+    # ... and the CLI exits non-zero printing file:line + rule id
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "--root", str(tmp_path),
+         "--no-project-rules", "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert (f"lightgbm_tpu/models/tree.py:{hazard_line}: TPL001"
+            in proc.stdout), proc.stdout
+
+
+def test_cli_clean_exit_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.tpulint", "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# 2. rule correctness on fixtures
+# ---------------------------------------------------------------------------
+def test_fixtures_match_expect_markers():
+    findings, by_rel = run_lint([FIXTURES], root=REPO,
+                                project_rules=False)
+    got = {}
+    for f in findings:
+        got.setdefault(os.path.basename(f.file), set()).add((f.line, f.rule))
+    for name in sorted(os.listdir(FIXTURES)):
+        if not name.endswith(".py"):
+            continue
+        expected = _markers(os.path.join(FIXTURES, name))
+        actual = got.get(name, set())
+        assert actual == expected, (
+            f"{name}: expected {sorted(expected)}, got {sorted(actual)}")
+
+
+def test_tpl005_oracle_coverage(tmp_path):
+    ops = tmp_path / "ops"
+    ops.mkdir()
+    shutil.copy(os.path.join(FIXTURES, "tpl005_kernel.py"),
+                ops / "pallas_fake.py")
+    (tmp_path / "tests").mkdir()
+    findings, _ = run_lint(["ops"], root=str(tmp_path))
+    assert any(f.rule == "TPL005" and f.file == "ops/pallas_fake.py"
+               for f in findings), [f.render() for f in findings]
+    # an interpret-mode oracle test referencing the module clears it
+    (tmp_path / "tests" / "test_pallas_fake.py").write_text(
+        "from ops import pallas_fake\n"
+        "# oracle: compare against interpret=True\n")
+    findings2, _ = run_lint(["ops"], root=str(tmp_path))
+    assert not any(f.rule == "TPL005" for f in findings2)
+
+
+def test_tpl008_doc_drift(tmp_path):
+    (tmp_path / "BENCH_r01.json").write_text(json.dumps(
+        {"parsed": {"value": 34.4e6, "full_row_iters_per_sec": 41.6e6}}))
+    readme = tmp_path / "README.md"
+    readme.write_text(
+        "Latest measured run:\n\n```\nleg: 99.9M row-iters/s\n```\n"
+        "prose about the 22.0M row-iters/s CPU baseline is exempt\n")
+    findings, _ = run_lint([], root=str(tmp_path))
+    assert [f.rule for f in findings] == ["TPL008"], \
+        [f.render() for f in findings]
+    readme.write_text(
+        "Latest measured run:\n\n```\nleg: 34.5M row-iters/s\n```\n")
+    findings2, _ = run_lint([], root=str(tmp_path))
+    assert not findings2, [f.render() for f in findings2]
+
+
+def test_baseline_roundtrip(tmp_path):
+    mod = tmp_path / "mod.py"
+    shutil.copy(os.path.join(FIXTURES, "tpl001_pos.py"), mod)
+    findings, by_rel = run_lint(["mod.py"], root=str(tmp_path),
+                                project_rules=False)
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), findings, by_rel)
+    # round-trip: everything pinned -> no new findings
+    again, by_rel2 = run_lint(["mod.py"], root=str(tmp_path),
+                              project_rules=False)
+    assert not new_findings(again, by_rel2, load_baseline(str(bl_path)))
+    # a NEW hazard (distinct line text) surfaces through the pin
+    mod.write_text(mod.read_text() + (
+        "\n\n@jax.jit\n"
+        "def fresh_hazard(z):\n"
+        "    return z.prod().item()\n"))
+    third, by_rel3 = run_lint(["mod.py"], root=str(tmp_path),
+                              project_rules=False)
+    fresh = new_findings(third, by_rel3, load_baseline(str(bl_path)))
+    assert len(fresh) == 1 and fresh[0].rule == "TPL001", \
+        [f.render() for f in fresh]
+
+
+# ---------------------------------------------------------------------------
+# 3. runtime trace contract
+# ---------------------------------------------------------------------------
+def test_trace_contract_catches_shape_unstable():
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.obs.trace_contract import CompileTracker
+    with CompileTracker() as tr:
+        f = jax.jit(lambda x: x * 2 + 1)
+        f(jnp.ones(4))
+        tr.mark_steady()
+        f(jnp.ones(5))          # shape change -> steady recompile
+    rep = tr.report()
+    assert rep["compiles_steady"] >= 1 and not rep["steady_ok"], rep
+
+
+def test_trace_contract_stable_function_clean():
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.obs.trace_contract import CompileTracker
+    with CompileTracker() as tr:
+        g = jax.jit(lambda x: x + 1)
+        g(jnp.ones(3))
+        tr.mark_steady()
+        for _ in range(4):
+            g(jnp.ones(3))
+    rep = tr.report()
+    assert rep["steady_ok"] and rep["compiles_steady"] == 0, rep
+
+
+def test_trace_contract_clean_on_training(monkeypatch):
+    """Acceptance: the tier-1 training path (CPU, train + valid,
+    multiple eval windows) reports zero post-warmup recompiles,
+    surfaced in the telemetry summary."""
+    monkeypatch.setenv("LGBM_TPU_TRACE_CONTRACT", "1")
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+    obs.reset()
+    try:
+        rng = np.random.RandomState(7)
+        X = rng.rand(300, 5)
+        y = (X[:, 0] + 0.2 * rng.rand(300) > 0.6).astype(np.float64)
+        Xv = rng.rand(120, 5)
+        yv = (Xv[:, 0] + 0.2 * rng.rand(120) > 0.6).astype(np.float64)
+        train = lgb.Dataset(X, label=y)
+        valid = lgb.Dataset(Xv, label=yv, reference=train)
+        booster = lgb.train(
+            {"objective": "binary", "num_iterations": 12, "num_leaves": 7,
+             "min_data_in_leaf": 5, "output_freq": 4, "verbose": -1},
+            train, valid_sets=[valid])
+        assert booster.num_trees() > 0
+        rep = obs.summary().get("trace_contract")
+        assert rep is not None, "trace_contract section missing"
+        assert rep["compiles_steady"] == 0 and rep["steady_ok"], rep
+    finally:
+        obs.reset()

@@ -1,0 +1,8 @@
+"""tpulint fixture: TPL000 negative — justified suppression (and the
+suppressed TPL001 stays silenced)."""
+import jax
+
+
+@jax.jit
+def f(x):
+    return float(x)  # tpulint: disable=TPL001 -- x is a static Python scalar here

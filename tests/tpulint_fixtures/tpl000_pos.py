@@ -1,0 +1,8 @@
+"""tpulint fixture: TPL000 positive — suppression without justification."""
+import jax
+
+
+@jax.jit
+def f(x):
+    # EXPECT-NEXT: TPL000
+    return float(x)  # tpulint: disable=TPL001

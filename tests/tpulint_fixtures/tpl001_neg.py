@@ -1,0 +1,22 @@
+"""tpulint fixture: TPL001 negatives — no findings expected."""
+import jax
+import jax.numpy as jnp
+
+
+@jax.jit
+def traced_clean(x):
+    s = jnp.sum(x)
+    return jnp.where(s > 0, s, -s)
+
+
+def host_sync_ok(arr):
+    # host side of the jit boundary: a deliberate sync is fine
+    vals = [float(v) for v in arr.tolist()]
+    return arr.sum().item() + len(vals)
+
+
+@jax.jit
+def shape_reads_ok(x):
+    # .shape/.ndim reads are static, not syncs
+    n = x.shape[0]
+    return x * n

@@ -1,0 +1,41 @@
+"""tpulint fixture: TPL001 positives — host syncs inside traced code.
+
+Marker protocol (parsed by tests/test_tpulint.py): ``# EXPECT: TPLxxx``
+on the offending line, or ``# EXPECT-NEXT: TPLxxx`` on the line above
+when the offending line can't carry a trailing comment.  The linter
+must report EXACTLY the marked (line, rule) pairs for each fixture.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def traced_sync(x):
+    v = x.sum().item()                  # EXPECT: TPL001
+    a = np.asarray(x)                   # EXPECT: TPL001
+    b = float(jnp.max(x))               # EXPECT: TPL001
+    g = jax.device_get(x)               # EXPECT: TPL001
+    total = jnp.float32(0.0)
+    for row in x:                       # EXPECT: TPL001
+        total = total + row
+    return v + a[0] + b + g[0] + total
+
+
+def scan_body(carry, x):
+    carry = carry + int(x)              # EXPECT: TPL001
+    return carry, x
+
+
+def run_scan(xs):
+    return jax.lax.scan(scan_body, 0, xs)
+
+
+@jax.jit
+def outer(x):
+    return _helper(x)
+
+
+def _helper(x):
+    # reached from a jit entry point via the call-graph walk
+    return x.mean().item()              # EXPECT: TPL001

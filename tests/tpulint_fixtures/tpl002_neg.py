@@ -1,0 +1,22 @@
+"""tpulint fixture: TPL002 negatives — static/constant usage is fine."""
+import functools
+
+import jax
+
+_CONST = 7          # assigned once, never mutated: safe to close over
+
+
+@functools.partial(jax.jit, static_argnames=("n",))
+def static_scalar_ok(x, n=4):
+    return x * n
+
+
+@jax.jit
+def reads_const_ok(x):
+    return x * _CONST
+
+
+def host_mutable_default_ok(x, acc=[]):
+    # not traced: Python semantics apply, linter stays out of it
+    acc.append(x)
+    return acc

@@ -1,0 +1,25 @@
+"""tpulint fixture: TPL002 positives — recompile hazards."""
+import jax
+
+_FLAGS = [True]
+
+
+def _toggle():
+    _FLAGS[0] = False
+
+
+@jax.jit
+def retrace_per_value(x, n=4):          # EXPECT: TPL002
+    return x * n
+
+
+@jax.jit
+def mutable_default(x, acc=[]):         # EXPECT: TPL002
+    return x
+
+
+@jax.jit
+def reads_mutated_global(x):
+    if _FLAGS[0]:                       # EXPECT: TPL002
+        return x * 2
+    return x

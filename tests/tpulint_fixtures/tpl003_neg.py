@@ -1,0 +1,8 @@
+"""tpulint fixture: TPL003 negatives (host-only module, no jax import):
+dtype-less np.array stays host-side, f64 is the numpy default there."""
+import numpy as np
+
+
+def host_stats(vals):
+    arr = np.array(vals)
+    return np.float64(arr.mean())

@@ -1,0 +1,14 @@
+"""tpulint fixture: TPL003 positives — dtype creep toward the device."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@jax.jit
+def widens(x):
+    y = x.astype(jnp.float64)           # EXPECT: TPL003
+    return y * 2.0
+
+
+def feeds_device(vals):
+    return jnp.asarray(np.array(vals))  # EXPECT: TPL003

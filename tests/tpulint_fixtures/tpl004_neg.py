@@ -1,0 +1,17 @@
+"""tpulint fixture: TPL004 negatives — retry-wrapped collectives."""
+import jax
+from jax.experimental import multihost_utils
+
+from lightgbm_tpu.utils.retry import retry_call, retrying
+
+
+def guarded_gather(x):
+    def _gather():
+        return multihost_utils.process_allgather(x)
+    return retry_call(_gather, what="collective.allgather")
+
+
+def guarded_init(**kwargs):
+    def _connect():
+        jax.distributed.initialize(**kwargs)
+    return retrying(_connect, what="rendezvous.connect")()

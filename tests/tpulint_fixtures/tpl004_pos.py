@@ -1,0 +1,13 @@
+"""tpulint fixture: TPL004 positives — unguarded collective primitives."""
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+
+
+def unguarded_gather(x):
+    arr = np.asarray(x, np.float32)
+    return multihost_utils.process_allgather(arr)   # EXPECT: TPL004
+
+
+def unguarded_init():
+    jax.distributed.initialize()                    # EXPECT: TPL004

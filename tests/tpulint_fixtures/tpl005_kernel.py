@@ -1,0 +1,17 @@
+"""tpulint fixture: a Pallas kernel module.  TPL005 is a project-level
+rule (it needs a tests/ directory to search), so this file carries no
+EXPECT markers — tests/test_tpulint.py copies it into a temp project
+root as ``ops/pallas_fake.py`` and asserts the finding appears exactly
+when no interpret-mode oracle test exists."""
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2
+
+
+def double(x):
+    return pl.pallas_call(
+        _kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)

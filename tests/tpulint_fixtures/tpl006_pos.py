@@ -1,0 +1,15 @@
+"""tpulint fixture: TPL006 positives — silent broad excepts."""
+
+
+def swallow(fn):
+    try:
+        return fn()
+    except Exception:                   # EXPECT: TPL006
+        return None
+
+
+def swallow_bare(fn):
+    try:
+        return fn()
+    except:                             # EXPECT: TPL006  # noqa: E722
+        return None

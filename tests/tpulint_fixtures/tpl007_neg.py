@@ -1,0 +1,12 @@
+"""tpulint fixture: TPL007 negatives — method calls named print, logs."""
+from lightgbm_tpu.utils.log import log_info
+
+
+class Reporter:
+    def print(self):
+        return "report"
+
+
+def quiet(r: Reporter):
+    log_info("rendering report")
+    return r.print()

@@ -1,0 +1,6 @@
+"""tpulint fixture: TPL007 positive — bare print in library code."""
+
+
+def noisy(x):
+    print("value:", x)                  # EXPECT: TPL007
+    return x

@@ -1,0 +1,5 @@
+"""Developer tooling (not shipped with the library).
+
+``tools.tpulint`` is importable (``python -m tools.tpulint``); the rest
+of this directory is standalone scripts.
+"""

@@ -1,0 +1,50 @@
+"""tpulint — JAX/TPU hazard linter + trace-contract checker.
+
+AST-based static analysis for the ``lightgbm_tpu`` package (rules
+TPL000-TPL008, see ``rules.py``/``doccheck.py``) run as a tier-1 gate
+via ``tests/test_tpulint.py`` and by hand via::
+
+    python -m tools.tpulint [--update-baseline] [paths...]
+
+The companion RUNTIME check — zero post-warmup recompiles on the
+training path — lives in ``lightgbm_tpu/obs/trace_contract.py`` (the
+library must not import tools/); this package only gates its output.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .core import (BASELINE_DEFAULT, FileInfo, Finding, count_keys,
+                   discover_files, finding_key, load_baseline,
+                   new_findings, suppressed, write_baseline)
+from .doccheck import rule_tpl008
+from .rules import FILE_RULES, RULE_TITLES, build_context
+
+__all__ = [
+    "run_lint", "Finding", "RULE_TITLES", "load_baseline",
+    "write_baseline", "new_findings", "BASELINE_DEFAULT",
+]
+
+
+def run_lint(paths: Sequence[str] = ("lightgbm_tpu",),
+             root: Optional[str] = None,
+             project_rules: bool = True,
+             ) -> Tuple[List[Finding], Dict[str, FileInfo]]:
+    """Lint ``paths`` (files or directories, relative to ``root``).
+    Returns (findings sorted by location, FileInfo by relative path).
+    Inline suppressions are already applied; the baseline is NOT —
+    callers diff via :func:`new_findings`."""
+    root = os.path.abspath(root or os.getcwd())
+    files = discover_files(paths, root)
+    ctx = build_context(files, root, project_rules=project_rules)
+    findings: List[Finding] = []
+    for fi in files:
+        for rule in FILE_RULES:
+            for f in rule(fi, ctx):
+                if not suppressed(fi, f):
+                    findings.append(f)
+    if project_rules:
+        findings.extend(rule_tpl008(root))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings, ctx.by_rel

@@ -1,0 +1,192 @@
+"""Traced-code reachability: which functions run under a JAX trace.
+
+TPL001/TPL003 only make sense INSIDE traced code — ``.item()`` in the
+host training loop is a deliberate sync, the same call inside a
+``jax.jit`` body is a silent per-iteration device round-trip (or a
+``TracerArrayConversionError`` on the good days).  The reachability
+set is computed in two steps:
+
+1. **Roots** — functions that enter a trace directly: decorated with
+   ``@jax.jit`` / ``@functools.partial(jax.jit, ...)``, wrapped via
+   ``jax.jit(f)`` / ``shard_map(f, ...)`` / ``pl.pallas_call(f, ...)``,
+   or passed as the body of ``lax.scan`` / ``fori_loop`` /
+   ``while_loop`` / ``cond`` / ``vmap`` / ``pmap``.
+2. **Propagation** — a name-based call-graph walk: every function whose
+   bare name is called from a traced function is traced too.  Name
+   resolution is deliberately coarse (``self._block_sample`` marks every
+   ``_block_sample`` in the package, including subclass overrides —
+   which is exactly right for dispatch we can't resolve statically);
+   the baseline absorbs the rare over-taint.
+
+Nested ``def``s count as part of their parent's subtree when scanning
+(a closure built inside a traced body runs under the same trace), and
+are also first-class graph nodes so ``jax.jit(inner)`` marks them.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .core import FileInfo
+
+# call-wrapping entry points: callee attr/name -> indices of traced args
+_WRAP_ARG_POS: Dict[str, Tuple[int, ...]] = {
+    "jit": (0,),
+    "pjit": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "shard_map": (0,),
+    "pallas_call": (0,),
+    "scan": (0,),
+    "fori_loop": (2,),
+    "while_loop": (0, 1),
+    "cond": (1, 2),
+    "switch": (1, 2, 3, 4),
+    "custom_vjp": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+}
+
+
+@dataclass
+class FunctionInfo:
+    """One def (incl. nested) with what the rules need to know."""
+    fi: FileInfo
+    node: ast.AST                   # FunctionDef / AsyncFunctionDef
+    qualname: str                   # "<rel>::outer.inner"
+    name: str                       # bare name
+    is_root: bool = False
+    jit_like: bool = False          # root via jit/pjit (statics apply)
+    static_argnames: Set[str] = field(default_factory=set)
+    called: Set[str] = field(default_factory=set)   # bare callee names
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    """Bare name of a call target: ``f(...)`` -> f, ``a.b.f(...)`` -> f."""
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _static_argnames_from_call(call: ast.Call) -> Set[str]:
+    """Parse ``static_argnames=("a", "b")`` out of a jit/partial call."""
+    out: Set[str] = set()
+    for kw in call.keywords:
+        if kw.arg != "static_argnames":
+            continue
+        v = kw.value
+        if isinstance(v, ast.Constant) and isinstance(v.value, str):
+            out.add(v.value)
+        elif isinstance(v, (ast.Tuple, ast.List, ast.Set)):
+            for el in v.elts:
+                if isinstance(el, ast.Constant) and isinstance(el.value, str):
+                    out.add(el.value)
+    return out
+
+
+def _jit_decoration(node: ast.AST) -> Optional[Set[str]]:
+    """If ``node`` is jit-decorated, return its static_argnames (possibly
+    empty); None when not jit-decorated."""
+    for dec in getattr(node, "decorator_list", []):
+        # @jax.jit / @jit
+        if _callee_name(dec) in ("jit", "pjit"):
+            return set()
+        if isinstance(dec, ast.Call):
+            callee = _callee_name(dec.func)
+            if callee in ("jit", "pjit"):               # @jax.jit(...)
+                return _static_argnames_from_call(dec)
+            if callee == "partial" and dec.args:        # @partial(jax.jit,)
+                if _callee_name(dec.args[0]) in ("jit", "pjit"):
+                    return _static_argnames_from_call(dec)
+    return None
+
+
+def collect_functions(fi: FileInfo) -> List[FunctionInfo]:
+    """All defs in ``fi`` (nested included), with jit-decoration roots
+    resolved and bare callee names recorded."""
+    out: List[FunctionInfo] = []
+
+    def visit(node: ast.AST, prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                info = FunctionInfo(fi=fi, node=child,
+                                    qualname=f"{fi.rel}::{qual}",
+                                    name=child.name)
+                statics = _jit_decoration(child)
+                if statics is not None:
+                    info.is_root = True
+                    info.jit_like = True
+                    info.static_argnames = statics
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Call):
+                        cn = _callee_name(sub.func)
+                        if cn is not None:
+                            info.called.add(cn)
+                out.append(info)
+                visit(child, qual)
+            else:
+                visit(child, prefix)
+
+    visit(fi.tree, "")
+    return out
+
+
+def _mark_wrapped_roots(fi: FileInfo, by_name: Dict[str, List[FunctionInfo]],
+                        local_names: Set[str]) -> None:
+    """Mark functions passed into jit/scan/shard_map/pallas_call wrappers
+    as traced roots (``jax.jit(f)``, ``lax.scan(body, ...)`` ...)."""
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = _callee_name(node.func)
+        if callee not in _WRAP_ARG_POS:
+            continue
+        statics = _static_argnames_from_call(node) if callee in (
+            "jit", "pjit") else set()
+        for pos in _WRAP_ARG_POS[callee]:
+            if pos >= len(node.args):
+                continue
+            arg = node.args[pos]
+            # unwrap functools.partial(f, ...) one level
+            if (isinstance(arg, ast.Call)
+                    and _callee_name(arg.func) == "partial" and arg.args):
+                arg = arg.args[0]
+            name = _callee_name(arg)
+            if name is None or name not in local_names:
+                continue
+            for info in by_name.get(name, []):
+                if info.fi.rel == fi.rel:
+                    info.is_root = True
+                    info.static_argnames |= statics
+                    if callee in ("jit", "pjit"):
+                        info.jit_like = True
+
+
+def compute_traced(files: Sequence[FileInfo]
+                   ) -> Tuple[Dict[str, FunctionInfo], Set[str]]:
+    """(all functions by qualname, set of TRACED qualnames)."""
+    functions: Dict[str, FunctionInfo] = {}
+    by_name: Dict[str, List[FunctionInfo]] = {}
+    for fi in files:
+        for info in collect_functions(fi):
+            functions[info.qualname] = info
+            by_name.setdefault(info.name, []).append(info)
+    for fi in files:
+        _mark_wrapped_roots(fi, by_name, set(by_name))
+
+    traced: Set[str] = set()
+    work = [q for q, info in functions.items() if info.is_root]
+    while work:
+        q = work.pop()
+        if q in traced:
+            continue
+        traced.add(q)
+        for callee in functions[q].called:
+            for info in by_name.get(callee, []):
+                if info.qualname not in traced:
+                    work.append(info.qualname)
+    return functions, traced
