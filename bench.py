@@ -1,7 +1,24 @@
 """Benchmark: HIGGS-equivalent binary GBDT training throughput on TPU.
 
-Prints ONE JSON line:
+Prints JSON lines of the form:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Emission is INCREMENTAL (VERDICT r5 Weak #1: round 5's driver timeout
+mid-ranking-leg erased every leg that had already passed): a parseable
+line is printed+flushed right after the 1M headline leg, again after
+the 10.5M full leg, and finally the complete line — a driver that
+takes the LAST parseable line can kill the process at any point after
+the headline without losing it.  ``BENCH_DEADLINE_S`` (seconds from
+process start; 0 = off) is a global budget: once exceeded, remaining
+auxiliary legs are recorded as ``"skipped: budget"`` instead of
+running, so the final line always lands inside the driver budget.
+
+Quality gates: the synthetic legs' train AUC must clear ``AUC_GATE``
+(``BENCH_AUC_GATE``, default 0.93 — calibrated from the recorded
+BENCH_r04 values 0.95956/0.9549 so a silent learning regression at
+0.86 can no longer pass the old 0.85 floor, VERDICT r5 Weak #7), and
+the with-valid leg's held-out AUC must clear ``BENCH_VALID_AUC_GATE``
+(default 0.90).
 
 Baseline (BASELINE.md): the reference trains HIGGS (10.5M rows x 28
 features, 500 iterations, num_leaves=255) in 238.505 s on a dual-Xeon
@@ -42,6 +59,22 @@ import numpy as np
 
 REFERENCE_ROW_ITERS_PER_SEC = 10.5e6 * 500 / 238.505
 REF_EXAMPLE = "/root/reference/examples/binary_classification"
+
+_T0 = time.monotonic()
+BENCH_DEADLINE_S = float(os.environ.get("BENCH_DEADLINE_S", "0") or 0)
+AUC_GATE = float(os.environ.get("BENCH_AUC_GATE", "0.93"))
+VALID_AUC_GATE = float(os.environ.get("BENCH_VALID_AUC_GATE", "0.90"))
+
+
+def _budget_exceeded() -> bool:
+    return (BENCH_DEADLINE_S > 0
+            and time.monotonic() - _T0 >= BENCH_DEADLINE_S)
+
+
+def _emit(line) -> None:
+    """Print one parseable artifact line NOW (the driver takes the last
+    parseable line, so every emission must be self-contained)."""
+    print(json.dumps(line), flush=True)
 
 
 def _auc(y, s):
@@ -323,8 +356,16 @@ def _leg(line, name, fn, retries=1, gate=False):
     a leg fails BOTH attempts with the SAME error — a deterministic
     crash, not a transient — it lands in ``legs_hard_failed`` and main
     zeroes ``vs_baseline``: a code regression that crashes the gate
-    path must not keep the headline green (ADVICE r5 #2)."""
+    path must not keep the headline green (ADVICE r5 #2).
+
+    Past the ``BENCH_DEADLINE_S`` budget the leg is not attempted at
+    all: it records ``"skipped: budget"`` (an explicit marker, never a
+    silent absence) and the headline keeps whatever legs DID run."""
     import gc
+    if _budget_exceeded():
+        line[f"{name}_leg"] = "skipped: budget"
+        line.setdefault("legs_skipped", []).append(name)
+        return None
     errs = []
     for attempt in range(retries + 1):
         try:
@@ -357,13 +398,16 @@ def main():
     # first-run experience, which running it after the big synthetic
     # legs distorts (~2 min of extra compile latency in a hot runtime)
     real = {}
-    try:
-        real = real_data_eval()
-    except Exception as exc:      # real-data leg must never kill the bench
-        real = {"real_data": f"failed: {exc}"}
+    if _budget_exceeded():
+        real = {"real_data": "skipped: budget"}
+    else:
+        try:
+            real = real_data_eval()
+        except Exception as exc:  # real-data leg must never kill the bench
+            real = {"real_data": f"failed: {exc}"}
 
     rps, auc, ph = synthetic_leg(n, iters, leaves, max_bin)
-    auc_ok = bool(auc >= 0.85)
+    auc_ok = bool(auc >= AUC_GATE)
     vs = rps / REFERENCE_ROW_ITERS_PER_SEC
     line = {
         "metric": "higgs_shape_train_row_iters_per_sec",
@@ -371,10 +415,16 @@ def main():
         "unit": "row_iters/s",
         "train_auc": round(auc, 5),
         "auc_ok": auc_ok,
+        "auc_gate": AUC_GATE,
         "throughput_data": "synthetic HIGGS-shaped",
         "compile_s": ph["compile_s"],
         "steady_s": ph["steady_s"],
     }
+    # headline checkpoint: from here on a driver timeout can no longer
+    # erase the 1M leg (the driver takes the LAST parseable line)
+    line["vs_baseline"] = round(vs if auc_ok else 0.0, 4)
+    line["partial"] = "headline-1M"
+    _emit(line)
 
     if os.environ.get("BENCH_FULL", "1") != "0":
         n_full = int(os.environ.get("BENCH_FULL_ROWS", 10_500_000))
@@ -388,7 +438,7 @@ def main():
             n_full, it_full, leaves, max_bin, seed=1))
         if full is not None:
             rps_f, auc_f, ph_f = full
-            auc_f_ok = bool(auc_f >= 0.85)
+            auc_f_ok = bool(auc_f >= AUC_GATE)
             line.update({
                 "full_rows": n_full, "full_iters": it_full,
                 "full_row_iters_per_sec": round(rps_f, 1),
@@ -401,8 +451,15 @@ def main():
             })
             auc_ok = auc_ok and auc_f_ok
             vs = min(vs, rps_f / REFERENCE_ROW_ITERS_PER_SEC)
-        else:                 # headline-constitutive: must not pass
+        elif line.get("full_leg") != "skipped: budget":
+            # headline-constitutive when it RAN and crashed: must not
+            # pass.  An explicit budget skip keeps the 1M headline (the
+            # marker stays loud in the artifact)
             auc_ok = False
+        # headline checkpoint #2: both headline legs are now settled
+        line["vs_baseline"] = round(vs if auc_ok else 0.0, 4)
+        line["partial"] = "headline-full"
+        _emit(line)
 
     # with-valid leg (VERDICT r4 #1): the standard train+valid+early-stop
     # workflow must stay on the fused block path, within ~20% of the
@@ -411,6 +468,12 @@ def main():
         vleg = _leg(line, "valid", lambda: valid_leg(leaves, max_bin),
                     gate=True)
         if vleg is not None:
+            # held-out AUC gate (VERDICT r5 Weak #7): the with-valid
+            # leg must actually generalize, not just stay fast
+            vleg["valid_auc_ok"] = bool(
+                vleg["valid_eval_auc"] >= VALID_AUC_GATE)
+            if not vleg["valid_auc_ok"]:
+                auc_ok = False
             vleg["valid_block_ok"] = bool(vleg["valid_on_block_path"])
             # the slowdown gate only means something when the no-valid
             # leg ran the SAME train-row count (shape differences would
@@ -437,7 +500,7 @@ def main():
             n255, it255, leaves, 255, seed=2), gate=True)
         if leg255 is not None:
             rps_255, auc_255, ph_255 = leg255
-            auc_255_ok = bool(auc_255 >= 0.85)
+            auc_255_ok = bool(auc_255 >= AUC_GATE)
             line.update({
                 "bin255_rows": n255, "bin255_iters": it255,
                 "bin255_row_iters_per_sec": round(rps_255, 1),
@@ -493,8 +556,12 @@ def main():
     line["vs_baseline"] = round(vs, 4)
     line["legs_ok"] = "legs_failed" not in line
     line["auc_ok"] = auc_ok
+    line.pop("partial", None)       # this is the complete line
+    if BENCH_DEADLINE_S > 0:
+        line["deadline_s"] = BENCH_DEADLINE_S
+        line["elapsed_s"] = round(time.monotonic() - _T0, 1)
     line.update(real)
-    print(json.dumps(line))
+    _emit(line)
 
 
 if __name__ == "__main__":

@@ -1354,8 +1354,21 @@ class GBDT:
                     # way the reference pins decisions to identical
                     # synced state (application.cpp:249-254)
                     from ..io.distributed import jax_process_allgather
-                    vals = jax_process_allgather(
-                        [float(r[2]) for r in results])[0]
+                    from ..obs import flight_recorder
+                    # the metric sync doubles as the window-boundary
+                    # schedule cross-check: every rank's collective
+                    # flight-recorder fingerprint rides the SAME
+                    # allgather (zero extra collectives; a mismatch
+                    # takes the rare second gather to localize the
+                    # first diverging site+rank — see
+                    # obs/flight_recorder.py)
+                    gathered = jax_process_allgather(
+                        {"vals": [float(r[2]) for r in results],
+                         "fr": flight_recorder.fingerprint()})
+                    vals = gathered[0]["vals"]
+                    flight_recorder.window_check(
+                        [g["fr"] for g in gathered],
+                        allgather=jax_process_allgather)
                     results = [(n, m, float(v), h) for (n, m, _, h), v
                                in zip(results, vals)]
                 if c.output_freq > 0 and it % c.output_freq == 0:

@@ -62,6 +62,7 @@ def jax_process_allgather(obj) -> List[object]:
     ``collective.allgather`` fault point sits in front for the
     robustness tests."""
     from ..obs import span
+    from ..obs.flight_recorder import record as fr_record
     from ..utils.faults import fault_point
     from ..utils.retry import retry_call
 
@@ -82,6 +83,11 @@ def jax_process_allgather(obj) -> List[object]:
         return [json.loads(bytes(g[r, :int(szs[r])]).decode())
                 for r in range(len(szs))]
 
+    # one fingerprint per LOGICAL collective (outside the retry loop: a
+    # retried rank joins the same collective late, it does not issue a
+    # new one); payload sizes legitimately differ per rank, so only the
+    # site+op enter the fingerprint
+    fr_record("io.distributed.jax_process_allgather", "process_allgather")
     # span around the WHOLE retried call: collective wall-clock in the
     # run summary includes retries + backoff (what the run actually paid)
     with span("collective.allgather"):
@@ -188,6 +194,7 @@ def find_bins_distributed(X_local: np.ndarray,
     ThreadedAllgather barrier and the reference's blocking sockets both
     tolerate that)."""
     from ..obs import span
+    from ..obs.flight_recorder import record as fr_record
     from ..utils.faults import fault_point
     from ..utils.retry import retrying
     inner = allgather
@@ -202,6 +209,7 @@ def find_bins_distributed(X_local: np.ndarray,
     # op times itself under "collective.allgather"; this one must not
     # double-count into the same bucket
     def allgather(obj):
+        fr_record("io.distributed.binfind_allgather", "allgather")
         with span("collective.binfind"):
             return _retry_ag(obj)
     cat_set = set(int(c) for c in categorical_features)

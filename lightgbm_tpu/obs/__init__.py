@@ -6,6 +6,9 @@ Import seam for the rest of the library::
     with span("snapshot.write") as s:
         ...
         s["bytes"] = n
+
+The collective flight recorder (``obs/flight_recorder.py``) rides the
+same summary plumbing: ``from ..obs import flight_recorder``.
 """
 from .telemetry import (counter_add, disable, enable, enabled, event,
                         gauge_set, merged_summary, reset, set_section,

@@ -1,0 +1,267 @@
+"""Collective flight recorder — the runtime half of the spmdcheck pair.
+
+``tools/spmdcheck`` proves statically that no code path ISSUES a
+rank-divergent collective schedule; this module proves at runtime that
+the ranks actually DID issue the same one (PyTorch's NCCL flight
+recorder attacks the same failure class from the same end).  The
+reference never needs it — its blocking socket collectives deadlock
+loudly and immediately on a schedule skew; XLA's async collectives on
+ICI/DCN instead hang minutes later or silently mis-reduce, with
+nothing naming the site that diverged (MULTICHIP_r05's ungated 1.63%
+row-leaf skew is exactly the signature this recorder exists to
+attribute).
+
+Mechanics:
+
+* every collective site — the ``shard_map`` wave collectives in
+  ``parallel/learners.py`` (recorded at TRACE time: each process
+  traces its own program, so trace-time Python is precisely where
+  rank-conditional control flow can skew the schedule) and the host
+  collectives in ``io/distributed.py`` / ``parallel/mesh.py``
+  (recorded per call) — appends a ``(site, op, axis, shape, dtype)``
+  fingerprint to a bounded per-rank ring buffer (``LGBM_TPU_FR_CAP``,
+  default 128 entries) and folds it into a rolling sha1 digest that
+  covers the ENTIRE history, not just the ring window;
+* fingerprint digests are cross-checked across ranks at window
+  boundaries, riding the existing host-collective merges: the
+  eval-window metric sync in ``boosting/gbdt.py`` and the telemetry
+  ``merged_summary`` path (every rank's summary carries its
+  ``flight_recorder`` section);
+* a mismatch emits a ``spmd:desync`` telemetry event naming the FIRST
+  diverging site and rank, logs a WARNING, and drops the evidence into
+  the summary under ``flight_recorder_check``;
+* on retry exhaustion (``utils/retry.py``) the last-K schedule is
+  dumped into the summary (``flight_recorder_dump``) — a hung
+  collective's post-mortem names what this rank was doing.
+
+Always on (recording is a lock + deque append + short sha1 at trace
+time / per host collective — nowhere near any per-row path); disable
+with ``LGBM_TPU_FLIGHT_RECORDER=0``.
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import deque
+from typing import Any, Dict, List, Optional, Sequence
+
+__all__ = [
+    "record", "snapshot", "fingerprint", "reset", "enabled",
+    "cross_check_summaries", "window_check", "dump_to_summary",
+]
+
+_lock = threading.Lock()
+_CAP = max(8, int(os.environ.get("LGBM_TPU_FR_CAP", "128") or 128))
+_ring: "deque[Dict[str, Any]]" = deque(maxlen=_CAP)
+_count = 0                      # entries ever recorded (ring may be smaller)
+_digest = ""                    # rolling sha1 over the full history
+
+
+def enabled() -> bool:
+    return os.environ.get("LGBM_TPU_FLIGHT_RECORDER", "1") != "0"
+
+
+def reset() -> None:
+    global _count, _digest
+    with _lock:
+        _ring.clear()
+        _count = 0
+        _digest = ""
+
+
+def _fp_str(entry: Dict[str, Any]) -> str:
+    return (f"{entry['site']}|{entry['op']}|{entry['axis']}|"
+            f"{entry['shape']}|{entry['dtype']}")
+
+
+def record(site: str, op: str, axis: Optional[str] = None,
+           operand: Any = None) -> None:
+    """Append one collective fingerprint.  ``operand`` may be a jax
+    array/tracer (shape/dtype read from its aval — no device sync) or
+    None for host object collectives whose payload sizes legitimately
+    differ per rank (only rank-INVARIANT fields may enter the
+    fingerprint, or the check would cry wolf)."""
+    if not enabled():
+        return
+    from ..utils.faults import FaultInjected, fault_point
+    try:
+        # the injection seam for desync tests: an armed skip makes THIS
+        # rank's schedule miss the site, exactly as rank-conditional
+        # control flow would
+        fault_point("spmd.skip_record")
+    except FaultInjected:
+        return
+    shape = getattr(operand, "shape", None)
+    dtype = getattr(operand, "dtype", None)
+    entry = {
+        "site": site, "op": op,
+        "axis": None if axis is None else str(axis),
+        "shape": None if shape is None else tuple(int(d) for d in shape),
+        "dtype": None if dtype is None else str(dtype),
+    }
+    global _count, _digest
+    with _lock:
+        entry["seq"] = _count
+        _ring.append(entry)
+        _count += 1
+        _digest = hashlib.sha1(
+            (_digest + _fp_str(entry)).encode()).hexdigest()[:16]
+
+
+def snapshot() -> Dict[str, Any]:
+    """This rank's recorder state: total count, rolling digest, last-K
+    entries (JSON-serializable — rides the telemetry summary)."""
+    with _lock:
+        return {"count": _count, "digest": _digest, "cap": _CAP,
+                "last": [dict(e) for e in _ring]}
+
+
+def fingerprint() -> List[Any]:
+    """Compact ``[count, digest]`` for cheap per-window cross-checks."""
+    with _lock:
+        return [_count, _digest]
+
+
+# ---------------------------------------------------------------------------
+# cross-rank checking
+# ---------------------------------------------------------------------------
+def _first_divergence(snaps: Sequence[Optional[Dict[str, Any]]]
+                      ) -> Optional[Dict[str, Any]]:
+    """Locate the first schedule divergence across per-rank snapshots.
+    Ranks are compared entry-by-entry on the fingerprint string; the
+    diverging rank is the one whose stream differs from the majority
+    (ties blame the shorter stream: a skipped collective shows up as a
+    missing entry).  Returns None when the divergence predates every
+    ring window (the digests still prove it happened)."""
+    per_rank: List[Dict[int, Dict[str, Any]]] = []
+    for s in snaps:
+        entries = (s or {}).get("last", [])
+        per_rank.append({int(e["seq"]): e for e in entries})
+    counts = [(s or {}).get("count", 0) for s in snaps]
+    all_seqs = sorted({q for m in per_rank for q in m})
+    unknown = ("<evicted>", "<not-yet>")
+    for seq in all_seqs:
+        # a seq a rank counted but whose ring entry was evicted is
+        # UNKNOWN, not divergent (only the window is bounded, not the
+        # digest); a seq past a rank's count is handled after the loop
+        fps = [(_fp_str(m[seq]) if seq in m
+                else ("<evicted>" if seq < counts[r] else "<not-yet>"))
+               for r, m in enumerate(per_rank)]
+        vals = {fp for fp in fps if fp not in unknown}
+        if len(vals) <= 1:
+            continue
+        # majority fingerprint; deviants are the diverging ranks
+        tally: Dict[str, int] = {}
+        for fp in fps:
+            if fp not in unknown:
+                tally[fp] = tally.get(fp, 0) + 1
+        majority = max(sorted(tally), key=lambda k: tally[k])
+        deviants = [r for r, fp in enumerate(fps)
+                    if fp not in unknown and fp != majority]
+        if not deviants:
+            continue
+        # shorter stream first: a skipped collective truncates it
+        deviants.sort(key=lambda r: (counts[r], -r))
+        site_entry = next((m[seq] for m in per_rank if seq in m), None)
+        return {
+            "seq": seq,
+            "site": site_entry["site"] if site_entry else None,
+            "op": site_entry["op"] if site_entry else None,
+            "rank": deviants[0],
+            "ranks": deviants,
+            "entries": {r: (per_rank[r].get(seq) or fps[r])
+                        for r in range(len(per_rank))},
+        }
+    # streams agree entry-for-entry but some rank stopped short: checks
+    # run at synchronization barriers, so "not yet there" IS "skipped" —
+    # the divergence sits at the shortest stream's end, and the site is
+    # whatever the longer ranks issued there
+    if len(set(counts)) > 1:
+        seq = min(counts)
+        site_entry = next((m[seq] for m in per_rank if seq in m), None)
+        deviants = sorted([r for r, c in enumerate(counts) if c == seq],
+                          key=lambda r: -r)
+        return {
+            "seq": seq,
+            "site": site_entry["site"] if site_entry else None,
+            "op": site_entry["op"] if site_entry else None,
+            "rank": deviants[0],
+            "ranks": deviants,
+            "entries": {r: per_rank[r].get(seq) or "<missing>"
+                        for r in range(len(per_rank))},
+        }
+    return None
+
+
+def _report_desync(div: Optional[Dict[str, Any]],
+                   counts: Sequence[int],
+                   digests: Sequence[str]) -> Dict[str, Any]:
+    from ..utils.log import log_warning
+    from .telemetry import event
+    out: Dict[str, Any] = {"ok": False, "counts": list(counts),
+                           "digests": list(digests)}
+    if div is not None:
+        out["first_divergence"] = div
+        log_warning(
+            f"spmd desync: collective schedule diverged at seq "
+            f"{div['seq']} site {div['site']!r} — rank {div['rank']} "
+            f"disagrees (per-rank counts {list(counts)})")
+        event("spmd", "desync", site=div["site"], rank=div["rank"],
+              seq=div["seq"])
+    else:
+        out["first_divergence"] = None
+        log_warning(
+            f"spmd desync: schedule digests differ but the divergence "
+            f"predates the ring window (counts {list(counts)}); raise "
+            f"LGBM_TPU_FR_CAP to localize")
+        event("spmd", "desync", site=None, rank=None, seq=None)
+    return out
+
+
+def cross_check_summaries(rank_summaries: Sequence[Dict[str, Any]]
+                          ) -> Optional[Dict[str, Any]]:
+    """Cross-rank schedule check over merged telemetry summaries (each
+    carrying its rank's ``flight_recorder`` section).  Returns None
+    when no rank recorded anything; otherwise a check report —
+    ``{"ok": True, ...}`` or the desync evidence."""
+    snaps = [s.get("flight_recorder") for s in rank_summaries]
+    if not any(snaps):
+        return None
+    counts = [(s or {}).get("count", 0) for s in snaps]
+    digests = [(s or {}).get("digest", "") for s in snaps]
+    if len(set(counts)) == 1 and len(set(digests)) == 1:
+        return {"ok": True, "count": counts[0], "digest": digests[0]}
+    return _report_desync(_first_divergence(snaps), counts, digests)
+
+
+def window_check(fingerprints: Sequence[Sequence[Any]],
+                 allgather=None) -> bool:
+    """Cheap per-window check over ``[count, digest]`` pairs gathered
+    from every rank (piggybacked on an existing host collective, e.g.
+    the eval-window metric sync).  On mismatch, a SECOND allgather (the
+    rare path) exchanges the last-K rings to localize the first
+    diverging site+rank.  Returns True when schedules agree."""
+    from .telemetry import counter_add, set_section
+    counter_add("spmd.window_checks")
+    counts = [int(fp[0]) for fp in fingerprints]
+    digests = [str(fp[1]) for fp in fingerprints]
+    if len(set(counts)) == 1 and len(set(digests)) == 1:
+        return True
+    div = None
+    if allgather is not None:
+        snaps = allgather(snapshot())
+        div = _first_divergence(snaps)
+    report = _report_desync(div, counts, digests)
+    set_section("flight_recorder_check", report)
+    return False
+
+
+def dump_to_summary(reason: str) -> None:
+    """Drop the last-K schedule into the telemetry summary (called on
+    retry exhaustion / gate failures): the post-mortem for a hung or
+    failed collective is what this rank had issued up to that point."""
+    from .telemetry import set_section
+    dump = snapshot()
+    dump["reason"] = reason
+    set_section("flight_recorder_dump", dump)

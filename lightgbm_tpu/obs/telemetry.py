@@ -128,7 +128,9 @@ def disable() -> None:
 
 
 def reset() -> None:
-    """Clear the run summary and forget any requested trace (tests)."""
+    """Clear the run summary and forget any requested trace (tests).
+    Also rewinds the collective flight recorder — a fresh run must not
+    inherit the previous run's schedule digest."""
     global _trace_requested, _held
     with _lock:
         disable()
@@ -141,6 +143,8 @@ def reset() -> None:
         _sections.clear()
         if getattr(_tls, "stack", None):
             _tls.stack = []
+    from . import flight_recorder
+    flight_recorder.reset()
 
 
 def trace_path() -> Optional[str]:
@@ -354,8 +358,13 @@ def set_section(name: str, data: Any) -> None:
 
 
 def summary() -> Dict[str, Any]:
-    """The in-memory run summary as a plain (JSON-serializable) dict."""
+    """The in-memory run summary as a plain (JSON-serializable) dict.
+    Carries this rank's collective flight-recorder state (ring + rolling
+    digest) so any cross-rank summary merge doubles as a schedule
+    cross-check (see :func:`merged_summary`)."""
     rank, world = _rank_world()
+    from . import flight_recorder
+    fr = flight_recorder.snapshot()
     with _lock:
         out = {
             "rank": rank,
@@ -366,6 +375,8 @@ def summary() -> Dict[str, Any]:
             "gauges": dict(_gauges),
             "events": dict(_events),
         }
+        if fr["count"]:
+            out["flight_recorder"] = fr
         out.update(_sections)
         return out
 
@@ -375,7 +386,9 @@ def merged_summary(allgather) -> Dict[str, Any]:
     ranks — ``allgather`` is the host-collective seam, normally
     ``io.distributed.jax_process_allgather``).  ``ranks`` keeps each
     rank's full summary; ``counters``/``events`` sum and ``spans``
-    combine across ranks."""
+    combine across ranks.  The per-rank ``flight_recorder`` sections
+    are cross-checked here: a schedule desync lands in
+    ``flight_recorder_check`` naming the first diverging site+rank."""
     locals_ = allgather(summary())
     merged: Dict[str, Any] = {
         "process_count": len(locals_),
@@ -395,6 +408,10 @@ def merged_summary(allgather) -> Dict[str, Any]:
             agg["count"] += v["count"]
             agg["total_s"] += v["total_s"]
             agg["max_s"] = max(agg["max_s"], v["max_s"])
+    from . import flight_recorder
+    check = flight_recorder.cross_check_summaries(locals_)
+    if check is not None:
+        merged["flight_recorder_check"] = check
     return merged
 
 

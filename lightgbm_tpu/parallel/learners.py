@@ -42,6 +42,20 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..obs.flight_recorder import record as _fr_record
+
+# jax >= 0.6 exposes shard_map at top level (replication checking via
+# `check_vma`); 0.4.x ships it under experimental with `check_rep`.
+# The alias keeps the bare name `shard_map` so the static analyzers'
+# name-based root detection (tpulint callgraph, spmdcheck) still sees
+# the wrapped function as a traced entry point.
+if hasattr(jax, "shard_map"):
+    shard_map = jax.shard_map
+    _SM_CHECK_KW = "check_vma"
+else:                               # jax 0.4.x fallback
+    from jax.experimental.shard_map import shard_map
+    _SM_CHECK_KW = "check_rep"
+
 from ..io.device import DeviceData
 from ..learner.serial import (BuiltTree, GrowthParams, apply_hist_wave,
                               build_tree, make_hist_fn)
@@ -51,12 +65,19 @@ from ..ops.split import (K_MIN_SCORE, SplitParams, SplitResult,
 
 
 def _psum(axis):
-    return lambda x: jax.lax.psum(x, axis)
+    def psum_fn(x):
+        # trace-time fingerprint: each process traces its own program,
+        # so THIS is where a rank-divergent schedule would be born
+        _fr_record("parallel.learners.hist_psum", "psum", axis, x)
+        return jax.lax.psum(x, axis)
+    return psum_fn
 
 
 def _sync_global_best(best: SplitResult, axis: str) -> SplitResult:
     """All-gather per-leaf SplitResults and keep the max-gain one — the
     ``SyncUpGlobalBestSplit`` reducer (`parallel_tree_learner.h:184-207`)."""
+    _fr_record("parallel.learners.sync_global_best", "all_gather", axis,
+               best.gain)
     gathered = jax.tree.map(
         lambda a: jax.lax.all_gather(a, axis), best)      # [S, 2A, ...]
     win = jnp.argmax(gathered.gain, axis=0)               # [2A]
@@ -200,7 +221,11 @@ def make_voting_parallel_strategy(data: DeviceData, grad, hess,
         local_vals = jnp.where(
             jnp.isfinite(local_vals) & (local_vals > K_MIN_SCORE / 2),
             local_vals, 0.0)
+        _fr_record("parallel.learners.voting.vote_gather", "all_gather",
+                   axis, local_top)
         g_top = jax.lax.all_gather(local_top, axis)      # [S, 2A, k] i32
+        _fr_record("parallel.learners.voting.vote_gather", "all_gather",
+                   axis, local_vals)
         g_val = jax.lax.all_gather(local_vals, axis)     # [S, 2A, k] f32
         # GlobalVoting: weighted-gain vote tally, scattered LOCALLY
         rows = jnp.arange(local_gain.shape[0])[None, :, None]
@@ -209,6 +234,8 @@ def make_voting_parallel_strategy(data: DeviceData, grad, hess,
         # psum ONLY the selected features' histogram columns
         sel_grid = jnp.take_along_axis(
             grid, sel_feats[:, :, None, None], axis=1)   # [2A, k2, B, 3]
+        _fr_record("parallel.learners.voting.sel_psum", "psum", axis,
+                   sel_grid)
         sel_grid = jax.lax.psum(sel_grid, axis)
         nb = data.num_bins[sel_feats]
         mt = data.missing_types[sel_feats]
@@ -333,8 +360,8 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
     in_specs = (vec, P(), P(), P(), P(), P(), P(), P(), P(),
                 vec, vec, vec, P())
 
-    fn = jax.shard_map(step, mesh=mesh, in_specs=in_specs,
-                       out_specs=out_spec, check_vma=False)
+    fn = shard_map(step, mesh=mesh, in_specs=in_specs,
+                   out_specs=out_spec, **{_SM_CHECK_KW: False})
     return fn(data.bins, data.bin_offsets, data.num_bins, data.default_bins,
               data.missing_types, data.is_categorical, data.nan_bins,
               data.feat_group, data.feat_offset,

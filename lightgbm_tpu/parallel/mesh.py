@@ -53,6 +53,8 @@ def init_distributed(coordinator_address: Optional[str] = None,
         fault_point("rendezvous.connect")
         jax.distributed.initialize(**kwargs)
 
+    from ..obs.flight_recorder import record as fr_record
+    fr_record("parallel.mesh.rendezvous", "distributed.initialize")
     from ..obs.telemetry import hold_trace, release_trace
     try:
         # retried with backoff: at pod startup the coordinator may come

@@ -11,7 +11,12 @@ the seams where production faults actually strike:
 * ``collective.allgather`` — a cross-rank collective call (DCN blip),
 * ``rendezvous.connect``   — the multi-host rendezvous handshake
   (coordinator not up yet),
-* ``loader.read``      — opening a data file (flaky remote filesystem).
+* ``loader.read``      — opening a data file (flaky remote filesystem),
+* ``spmd.skip_record`` — a collective site's flight-recorder fingerprint
+  is silently dropped (simulating rank-divergent control flow that
+  skips a collective; armed per-rank by the desync-localization tests —
+  the fault is CAUGHT inside ``obs/flight_recorder.record``, it never
+  propagates).
 
 Each point is a single ``fault_point(name)`` call that is a no-op unless
 armed.  Tests arm points programmatically (:func:`inject`, or the
@@ -36,7 +41,7 @@ import threading
 from typing import Dict, Optional
 
 POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
-          "loader.read")
+          "loader.read", "spmd.skip_record")
 
 
 class FaultInjected(RuntimeError):

@@ -140,6 +140,11 @@ def retry_call(fn: Callable, *args,
                 counter_add(f"retry.{what}.backoff_s", s)
                 _sleep(s)
     counter_add(f"retry.{what}.exhausted")
+    # post-mortem: the collective schedule this rank had issued when the
+    # site gave up — a desynced peer is the usual culprit for a
+    # collective that never recovers (see obs/flight_recorder.py)
+    from ..obs.flight_recorder import dump_to_summary
+    dump_to_summary(f"retry.{what}.exhausted")
     raise last
 
 

@@ -1,0 +1,86 @@
+"""Worker for the 2-process flight-recorder desync-localization test
+(run by ``tests/test_multihost.py``, one subprocess per rank).
+
+Scenario (PR 4 satellite): rank 1's control flow "skips" a collective —
+injected through ``utils/faults.py``'s ``spmd.skip_record`` point, which
+drops exactly one flight-recorder fingerprint on that rank, the same
+footprint a rank-conditional branch around a collective would leave.
+Both ranks then merge telemetry summaries over the host collective; the
+merged summary's ``flight_recorder_check`` must localize the fault to
+the EXACT site and the diverging rank on EVERY rank's copy of the
+merge (the check result is deterministic from the gathered sections).
+"""
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+os.environ["LGBM_TPU_RETRY_BASE_S"] = "0.01"
+os.environ["LGBM_TPU_RETRY_JITTER"] = "0"
+
+
+def main():
+    rank = int(sys.argv[1])
+    port = sys.argv[2]
+    out_dir = sys.argv[3]
+    world = 2
+
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_cpu_collectives_implementation", "gloo")
+
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.io.distributed import jax_process_allgather
+    from lightgbm_tpu.obs import flight_recorder
+    from lightgbm_tpu.parallel.mesh import init_distributed
+    from lightgbm_tpu.utils import faults
+
+    trace_base = os.path.join(out_dir, "trace.jsonl")
+    obs.enable(trace_path=trace_base)
+
+    init_distributed(f"localhost:{port}", num_processes=world,
+                     process_id=rank)
+    assert jax.process_count() == world, jax.process_count()
+
+    # a couple of healthy collectives first: the schedules agree so far
+    # (the rendezvous + these gathers are all fingerprinted)
+    jax_process_allgather({"step": 0, "rank": rank})
+    before = flight_recorder.snapshot()["count"]
+    assert before == 2          # rendezvous + step-0 allgather
+
+    # rank 1 "skips" the next collective: the injected fault drops its
+    # fingerprint, exactly as rank-conditional control flow would
+    if rank == 1:
+        faults.inject("spmd.skip_record", times=1)
+    jax_process_allgather({"step": 1, "rank": rank})
+    faults.clear("spmd.skip_record")
+    # ... and one more healthy one, so the divergence is mid-stream
+    jax_process_allgather({"step": 2, "rank": rank})
+
+    merged = obs.merged_summary(jax_process_allgather)
+    chk = merged.get("flight_recorder_check")
+    assert chk is not None, sorted(merged)
+    assert chk["ok"] is False, chk
+    div = chk["first_divergence"]
+    assert div is not None, chk
+    # the EXACT site: the skipped fingerprint was a jax_process_allgather
+    assert div["site"] == "io.distributed.jax_process_allgather", div
+    # ... and the EXACT rank that diverged
+    assert div["rank"] == 1, div
+    # rank 0 recorded 4 entries pre-merge, rank 1 recorded 3 (one
+    # skipped); every site in the tail is the same allgather seam, so
+    # localization resolves at the stream-length divergence, seq 3
+    assert div["seq"] == before + 1, div
+    # the desync event fired during the merge on every rank
+    assert obs.summary()["events"].get("spmd:desync") == 1
+
+    if rank == 0:
+        obs.write_summary(trace_base + ".summary.json", merged)
+    obs.disable()
+
+    print(f"SPMD_DESYNC_OK rank={rank}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,35 @@
+"""SPM001 negatives: uniform guards and unconditional collectives.
+
+`process_count`/`axis_size` are UNIFORM across ranks — branching on
+them cannot desync the schedule; rank-variant VALUES flowing into an
+unconditional collective are exactly what collectives are for.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def uniform_world_guard(obj):
+    if jax.process_count() > 1:
+        return jax_process_allgather(obj)
+    return [obj]
+
+
+def rank_guard_without_collective(x, axis):
+    idx = jax.lax.axis_index(axis)
+    y = jax.lax.psum(x, axis)       # before the branch: every rank issues it
+    if idx == 0:
+        y = y * 2
+    return y
+
+
+def rank_variant_operand(x, axis):
+    idx = jax.lax.axis_index(axis)
+    shifted = x + idx               # per-rank VALUE into the collective: fine
+    return jax.lax.psum(shifted, axis)
+
+
+def static_flag_guard(x, axis, extra_round):
+    y = jax.lax.psum(x, axis)
+    if extra_round:                 # closure-static: uniform across ranks
+        y = jax.lax.psum(y * 0.5, axis)
+    return y

@@ -1,0 +1,42 @@
+"""SPM001 positives: collectives under rank-conditional control flow.
+
+Each marked line is the collective that some ranks would skip or
+reorder — the schedule-desync seed the reference's identical-split
+contract (data_parallel_tree_learner.cpp:147-162) forbids.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def direct_guard(x, axis):
+    if jax.lax.axis_index(axis) == 0:
+        x = jax.lax.psum(x, axis)               # EXPECT: SPM001
+    return x
+
+
+def tainted_guard(x, axis):
+    r = jax.lax.axis_index(axis)
+    is_leader = r == 0
+    if is_leader:
+        x = jax.lax.all_gather(x, axis)         # EXPECT: SPM001
+    return x
+
+
+def host_guard(obj):
+    if jax.process_index() == 0:
+        return jax_process_allgather(obj)       # EXPECT: SPM001
+    return [obj]
+
+
+def while_guard(x, axis):
+    while jax.lax.axis_index(axis) < 1:
+        x = jax.lax.psum(x, axis)               # EXPECT: SPM001
+    return x
+
+
+def else_branch_guard(x, axis):
+    if jax.lax.axis_index(axis) > 0:
+        y = x * 2
+    else:
+        y = jax.lax.pmean(x, axis)              # EXPECT: SPM001
+    return y

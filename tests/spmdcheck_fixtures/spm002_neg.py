@@ -1,0 +1,28 @@
+"""SPM002 negatives: sibling branches with IDENTICAL (op, axis)
+schedules, and one-sided branches (a collective only one side issues is
+rank-safe when the predicate is uniform — SPM001 covers the case where
+it is not).
+"""
+import jax
+import jax.numpy as jnp
+
+
+def same_schedule_different_math(x, axis, flag):
+    if flag:
+        y = jax.lax.psum(x * 2.0, axis)
+    else:
+        y = jax.lax.psum(x + 1.0, axis)         # same (op, axis): fine
+    return y
+
+
+def one_sided_branch(x, axis, flag):
+    y = x
+    if flag:
+        y = jax.lax.psum(y, axis)               # no else schedule to clash
+    return y
+
+
+def no_collectives_at_all(x, flag):
+    if flag:
+        return x * 2.0
+    return x + 1.0

@@ -1,0 +1,31 @@
+"""SPM002 positives: sibling branches reach DIFFERENT collective
+schedules — whichever way the predicate resolves, the two sides cannot
+both match the peers' schedule if the predicate ever differs per rank.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def op_mismatch(x, axis, flag):
+    if flag:                                    # EXPECT: SPM002
+        y = jax.lax.psum(x, axis)
+    else:
+        y = jax.lax.all_gather(x, axis).sum(0)
+    return y
+
+
+def axis_mismatch(x, flag):
+    if flag:                                    # EXPECT: SPM002
+        y = jax.lax.psum(x, "data")
+    else:
+        y = jax.lax.psum(x, "feature")
+    return y
+
+
+def count_mismatch(x, axis, flag):
+    if flag:                                    # EXPECT: SPM002
+        y = jax.lax.psum(x, axis)
+        y = jax.lax.psum(y, axis)
+    else:
+        y = jax.lax.psum(x, axis)
+    return y

@@ -1,0 +1,31 @@
+"""SPM003 negatives: uniform trip counts and shapes; rank-variant
+VALUES (slice starts, operand contents) are the normal SPMD idiom.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def uniform_trip_count(x, axis, n):
+    for _ in range(n):                  # n is closure-uniform
+        x = jax.lax.psum(x, axis)
+    return x
+
+
+def per_rank_slice_then_gather(x, axis, f_local):
+    idx = jax.lax.axis_index(axis)
+    start = idx * f_local               # rank-variant START, static SIZE
+    loc = jax.lax.dynamic_slice_in_dim(x, start, f_local)
+    return jax.lax.all_gather(loc, axis)
+
+
+def tainted_loop_without_collectives(axis, items):
+    r = jax.lax.axis_index(axis)
+    acc = 0
+    for i in range(r):                  # rank-variant trip, local-only body
+        acc = acc + items[i]
+    return jax.lax.psum(acc, axis)      # one collective AFTER the loop
+
+
+def uniform_shape_from_sync(x, axis, cap):
+    pad = jnp.zeros(cap)                # cap pre-synced to a uniform max
+    return jax.lax.all_gather(jnp.concatenate([x, pad]), axis)

@@ -1,0 +1,27 @@
+"""SPM003 positives: rank-variant values feeding collective operand
+SHAPES or loop trip counts — per-rank shape or call-count divergence.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def tainted_trip_count(x, axis):
+    n = jax.lax.axis_index(axis) + 1
+    for _ in range(n):                          # EXPECT: SPM003
+        x = jax.lax.psum(x, axis)
+    return x
+
+
+def tainted_shape(x, axis):
+    k = jax.lax.axis_index(axis) + 1
+    pad = jnp.zeros(k)                          # EXPECT: SPM003
+    return jax.lax.all_gather(jnp.concatenate([x, pad]), axis)
+
+
+def tainted_fori(x, axis):
+    n = jax.lax.axis_index(axis)
+
+    def body(i, acc):
+        return acc + jax.lax.psum(x, axis)
+
+    return jax.lax.fori_loop(0, n, body, x)     # EXPECT: SPM003

@@ -1,0 +1,13 @@
+"""SPM004 negatives: host collectives through the sanctioned seam
+functions (retry + telemetry span + flight recorder ride along).
+"""
+
+
+def through_allgather_seam(obj):
+    from lightgbm_tpu.io.distributed import jax_process_allgather
+    return jax_process_allgather(obj)
+
+
+def through_rendezvous_seam(addr):
+    from lightgbm_tpu.parallel.mesh import init_distributed
+    init_distributed(coordinator_address=addr)

@@ -1,0 +1,16 @@
+"""SPM004 positives: host collective primitives used outside the
+io/distributed.py / parallel/mesh.py seam — the call loses the shared
+retry policy, the telemetry span, and the flight-recorder fingerprint.
+"""
+import numpy as np
+
+
+def direct_primitive(obj):
+    from jax.experimental import multihost_utils
+    return multihost_utils.process_allgather(    # EXPECT: SPM004
+        np.asarray(obj))
+
+
+def direct_rendezvous(addr):
+    import jax
+    jax.distributed.initialize(coordinator_address=addr)    # EXPECT: SPM004

@@ -1,0 +1,100 @@
+"""Budget-proofing of bench.py (VERDICT r5 Weak #1 / PR 4 satellite).
+
+Round 5's driver timeout mid-ranking-leg produced ``BENCH_r05.json``
+with rc=124 and ``parsed: null`` — every leg that had already PASSED
+was erased because the single JSON line only printed at the end.  The
+contract under test:
+
+* a parseable, self-contained headline line is flushed right after the
+  first synthetic leg (so a kill at ANY later point still leaves a
+  non-null artifact for a driver that takes the last parseable line);
+* past ``BENCH_DEADLINE_S``, every remaining auxiliary leg records an
+  explicit ``"skipped: budget"`` marker instead of running;
+* the final line is complete, parseable, and still carries the
+  headline numbers.
+
+The subprocess runs at toy shape (2k rows, 2 iters, 7 leaves) on CPU —
+this exercises emission/skip mechanics, not throughput.
+"""
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _parse_lines(stdout):
+    out = []
+    for ln in stdout.splitlines():
+        ln = ln.strip()
+        if not ln.startswith("{"):
+            continue
+        try:
+            out.append(json.loads(ln))
+        except ValueError:
+            pass
+    return out
+
+
+@pytest.fixture(scope="module")
+def bench_run(tmp_path_factory):
+    env = {**os.environ,
+           "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", ""),
+           # toy shapes: mechanics, not throughput
+           "BENCH_ROWS": "2000", "BENCH_ITERS": "2",
+           "BENCH_LEAVES": "7", "BENCH_BIN": "15",
+           "BENCH_FULL": "0",
+           # the deadline is already exceeded when the aux legs are
+           # reached: they must all record "skipped: budget"
+           "BENCH_DEADLINE_S": "0.000001"}
+    env.pop("XLA_FLAGS", None)
+    env.pop("BENCH_DATA", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    return proc
+
+
+def test_headline_line_survives_simulated_timeout(bench_run):
+    """The FIRST emitted line is a self-contained non-null headline:
+    killing the process at any point after it (the r05 timeout
+    scenario) leaves a parseable artifact."""
+    assert bench_run.returncode == 0, bench_run.stdout + bench_run.stderr
+    lines = _parse_lines(bench_run.stdout)
+    assert len(lines) >= 2, bench_run.stdout
+    first = lines[0]
+    assert first["metric"] == "higgs_shape_train_row_iters_per_sec"
+    assert first["value"] is not None and first["value"] > 0
+    assert "vs_baseline" in first
+    assert first.get("partial") == "headline-1M"
+
+
+def test_deadline_skips_aux_legs_with_markers(bench_run):
+    final = _parse_lines(bench_run.stdout)[-1]
+    assert "partial" not in final           # the complete line
+    assert final["value"] > 0               # headline retained
+    for leg in ("valid", "bin255", "rank", "rank63"):
+        assert final.get(f"{leg}_leg") == "skipped: budget", final
+    assert final.get("real_data") == "skipped: budget"
+    assert set(final.get("legs_skipped", [])) >= {
+        "valid", "bin255", "rank", "rank63"}
+    # an explicit skip is not a failure: no legs_failed / hard-failed
+    assert "legs_failed" not in final
+    assert "legs_hard_failed" not in final
+    assert final["deadline_s"] > 0 and final["elapsed_s"] >= 0
+
+
+def test_auc_gate_tightened_beyond_085(bench_run):
+    """VERDICT r5 Weak #7: the synthetic AUC floor must sit at the
+    recorded-r4-calibrated default (0.93), not the old 0.85 — and be
+    recorded in the artifact so a reader can see what gated it."""
+    final = _parse_lines(bench_run.stdout)[-1]
+    assert final["auc_gate"] >= 0.93
+    # toy-shape AUC may legitimately miss the gate; what matters is the
+    # verdict is derived from THIS gate and the headline value survives
+    assert final["auc_ok"] == (final["train_auc"] >= final["auc_gate"])

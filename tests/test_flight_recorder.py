@@ -1,0 +1,201 @@
+"""Collective flight recorder: ring/digest mechanics, cross-rank
+desync localization, fault-injected skips, and the telemetry/retry
+integration points (PR 4 tentpole, runtime half).
+"""
+import numpy as np
+import pytest
+
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import flight_recorder as fr
+from lightgbm_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.reset()         # also rewinds the recorder
+    faults.clear()
+    yield
+    obs.reset()
+    faults.clear()
+
+
+def test_record_and_snapshot_basics():
+    fr.record("site.a", "psum", "data", np.zeros((4, 2), np.float32))
+    fr.record("site.b", "all_gather", "data")
+    snap = fr.snapshot()
+    assert snap["count"] == 2
+    assert snap["digest"]
+    a, b = snap["last"]
+    assert a["site"] == "site.a" and a["op"] == "psum"
+    assert a["shape"] == (4, 2) and a["dtype"] == "float32"
+    assert a["seq"] == 0 and b["seq"] == 1
+    assert b["shape"] is None       # host object collective: no shape
+
+
+def test_digest_covers_full_history_beyond_ring():
+    for i in range(fr._CAP + 10):
+        fr.record("site", "psum", "data")
+    snap = fr.snapshot()
+    assert snap["count"] == fr._CAP + 10
+    assert len(snap["last"]) == fr._CAP         # ring bounded
+    d1 = snap["digest"]
+    fr.reset()
+    for i in range(fr._CAP + 10):
+        fr.record("site", "psum", "data")
+    assert fr.snapshot()["digest"] == d1        # deterministic
+    fr.record("site", "psum", "data")
+    assert fr.snapshot()["digest"] != d1        # history-sensitive
+
+
+def _summaries_with(snaps):
+    return [{"rank": r, "flight_recorder": s} for r, s in enumerate(snaps)]
+
+
+def _run(sites):
+    """Recorder state after recording ``sites`` in order, as a summary
+    section."""
+    fr.reset()
+    for s in sites:
+        fr.record(s, "allgather")
+    return fr.snapshot()
+
+
+def test_cross_check_identical_schedules_ok():
+    a = _run(["s1", "s2", "s3"])
+    b = _run(["s1", "s2", "s3"])
+    chk = fr.cross_check_summaries(_summaries_with([a, b]))
+    assert chk["ok"] and chk["count"] == 3
+
+
+def test_cross_check_localizes_skipped_site_and_rank():
+    full = _run(["s1", "s2", "s3"])
+    skipped = _run(["s1", "s3"])                # rank 1 skipped s2
+    chk = fr.cross_check_summaries(_summaries_with([full, skipped]))
+    assert not chk["ok"]
+    div = chk["first_divergence"]
+    assert div["seq"] == 1
+    assert div["site"] == "s2"                  # the exact skipped site
+    assert div["rank"] == 1                     # the diverging rank
+
+
+def test_cross_check_trailing_skip_blames_short_rank():
+    full = _run(["s1", "s2", "s3"])
+    short = _run(["s1", "s2"])                  # rank 0 ahead is NOT a
+    chk = fr.cross_check_summaries(             # divergence per se...
+        _summaries_with([full, short]))
+    # ...but the digests/counts differ, so the check still reports the
+    # first seq where rank 1's stream ended: site s3, rank 1
+    assert not chk["ok"]
+    assert chk["first_divergence"]["site"] == "s3"
+    assert chk["first_divergence"]["rank"] == 1
+
+
+def test_cross_check_majority_vote_three_ranks():
+    a = _run(["s1", "s2"])
+    b = _run(["s1", "s2"])
+    c = _run(["s1", "sX"])                      # rank 2 issued wrong site
+    chk = fr.cross_check_summaries(_summaries_with([a, b, c]))
+    assert not chk["ok"]
+    assert chk["first_divergence"]["rank"] == 2
+    assert chk["first_divergence"]["seq"] == 1
+
+
+def test_cross_check_none_when_nothing_recorded():
+    assert fr.cross_check_summaries([{"rank": 0}, {"rank": 1}]) is None
+
+
+def test_window_check_mismatch_dumps_section_and_event():
+    obs.enable()
+    a = _run(["s1", "s2", "s3"])
+    b = _run(["s1", "s3"])
+    fps = [[a["count"], a["digest"]], [b["count"], b["digest"]]]
+    ok = fr.window_check(fps, allgather=lambda snap: [a, b])
+    assert not ok
+    s = obs.summary()
+    assert s["flight_recorder_check"]["first_divergence"]["site"] == "s2"
+    assert s["flight_recorder_check"]["first_divergence"]["rank"] == 1
+    assert s["events"].get("spmd:desync") == 1
+
+
+def test_window_check_match_is_quiet():
+    obs.enable()
+    a = _run(["s1", "s2"])
+    assert fr.window_check([[a["count"], a["digest"]]] * 2)
+    assert "flight_recorder_check" not in obs.summary()
+    assert "spmd:desync" not in obs.summary()["events"]
+
+
+def test_skip_fault_point_drops_recording():
+    faults.inject("spmd.skip_record", times=1)
+    fr.record("s1", "psum", "data")             # skipped
+    fr.record("s2", "psum", "data")             # recorded
+    snap = fr.snapshot()
+    assert snap["count"] == 1
+    assert snap["last"][0]["site"] == "s2"
+    assert faults.fired("spmd.skip_record") == 1
+
+
+def test_summary_carries_recorder_section():
+    obs.enable()
+    assert "flight_recorder" not in obs.summary()   # empty ring: omitted
+    fr.record("s1", "psum", "data")
+    sec = obs.summary()["flight_recorder"]
+    assert sec["count"] == 1 and sec["last"][0]["site"] == "s1"
+    obs.reset()
+    assert fr.snapshot()["count"] == 0              # reset rewinds it
+
+
+def test_retry_exhaustion_dumps_schedule():
+    from lightgbm_tpu.utils.retry import RetryPolicy, retry_call
+    fr.record("collective.x", "allgather")
+
+    def boom():
+        raise RuntimeError("UNAVAILABLE: injected")
+
+    with pytest.raises(RuntimeError):
+        retry_call(boom, policy=RetryPolicy(attempts=2, base_s=0.0,
+                                            jitter=0.0),
+                   what="collective.x")
+    dump = obs.summary().get("flight_recorder_dump")
+    assert dump is not None
+    assert dump["reason"] == "retry.collective.x.exhausted"
+    assert dump["last"][0]["site"] == "collective.x"
+
+
+def test_disabled_via_env(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_FLIGHT_RECORDER", "0")
+    fr.record("s1", "psum", "data")
+    assert fr.snapshot()["count"] == 0
+
+
+def test_trace_time_recording_on_cpu_mesh():
+    """Building one distributed tree on the virtual CPU mesh records
+    the wave-collective schedule at trace time."""
+    import jax
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    import jax.numpy as jnp
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset
+    from lightgbm_tpu.io.device import to_device
+    from lightgbm_tpu.learner.serial import GrowthParams
+    from lightgbm_tpu.ops.split import SplitParams
+    from lightgbm_tpu.parallel.learners import build_tree_distributed
+    from lightgbm_tpu.parallel.mesh import make_mesh
+
+    rng = np.random.RandomState(0)
+    n, f = 256, 6
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] > 0).astype(np.float32)
+    dd = to_device(BinnedDataset.from_raw(
+        X, Config.from_params({"max_bin": 15})))
+    grad = jnp.asarray(-(y - y.mean()))
+    hess = jnp.ones(n)
+    p = GrowthParams(num_leaves=7, split=SplitParams(
+        min_data_in_leaf=2, min_sum_hessian_in_leaf=0.0))
+    fr.reset()
+    bt = build_tree_distributed(make_mesh(2), "data", "data", dd, grad,
+                                hess, p)
+    assert int(bt.num_leaves) >= 2
+    sites = {e["site"] for e in fr.snapshot()["last"]}
+    assert "parallel.learners.hist_psum" in sites, sites

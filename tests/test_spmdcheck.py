@@ -1,0 +1,193 @@
+"""Tier-1 gate: spmdcheck cross-rank collective-schedule analysis.
+
+Mirrors the tpulint gate's three layers (``tests/test_tpulint.py``):
+
+1. **Package gate** — ``lightgbm_tpu/`` must analyze clean against the
+   committed baseline (``tools/spmdcheck/baseline.json``, EMPTY).
+2. **Rule correctness** — every fixture under ``spmdcheck_fixtures/``
+   carries ``# EXPECT: SPMxxx`` markers; the analyzer must report
+   EXACTLY the marked (line, rule) pairs.
+3. **Seeded hazard** — injecting an SPM001 rank-conditional collective
+   into ``parallel/learners.py`` (the module whose schedule the rules
+   exist to protect) flips the gate red with the rule id and file:line.
+
+Both static gates share one parsed-AST cache (``tools.tpulint.core``),
+so running this file alongside ``test_tpulint.py`` parses each package
+file once.
+"""
+import os
+import re
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "spmdcheck_fixtures")
+
+from tools.spmdcheck import (BASELINE_DEFAULT, load_baseline,  # noqa: E402
+                             new_findings, render_schedules,
+                             run_spmdcheck, write_baseline)
+
+_EXPECT_RE = re.compile(
+    r"#\s*EXPECT(-NEXT)?:\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)")
+
+
+def _markers(path):
+    out = set()
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            m = _EXPECT_RE.search(line)
+            if not m:
+                continue
+            target = lineno + 1 if m.group(1) else lineno
+            for rule in m.group(2).split(","):
+                out.add((target, rule.strip()))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# 1. package gate
+# ---------------------------------------------------------------------------
+def test_package_clean_vs_baseline():
+    findings, by_rel = run_spmdcheck(["lightgbm_tpu"], root=REPO)
+    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    fresh = new_findings(findings, by_rel, baseline)
+    assert not fresh, ("new spmdcheck findings (fix, suppress with "
+                       "justification, or --update-baseline):\n"
+                       + "\n".join(f.render() for f in fresh))
+
+
+def test_committed_baseline_is_empty():
+    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    assert baseline == {}, ("the spmdcheck baseline must stay EMPTY — "
+                            "fix or justify-suppress instead of pinning: "
+                            f"{baseline}")
+
+
+SEED = ("\n\ndef _spmd_probe(x, axis):\n"
+        "    if jax.lax.axis_index(axis) == 0:\n"
+        "        x = jax.lax.psum(x, axis)\n"
+        "    return x\n")
+
+
+def test_seeded_hazard_fails_gate(tmp_path):
+    """Acceptance: an injected SPM001 rank-conditional collective in
+    parallel/learners.py fails the gate with rule id and file:line."""
+    pkg = tmp_path / "lightgbm_tpu"
+    shutil.copytree(os.path.join(REPO, "lightgbm_tpu"), pkg,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    target = pkg / "parallel" / "learners.py"
+    base_lines = len(target.read_text().splitlines())
+    target.write_text(target.read_text() + SEED)
+    hazard_line = base_lines + 5            # the guarded psum line
+
+    findings, by_rel = run_spmdcheck(["lightgbm_tpu"], root=str(tmp_path))
+    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    fresh = new_findings(findings, by_rel, baseline)
+    assert any(f.rule == "SPM001"
+               and f.file == "lightgbm_tpu/parallel/learners.py"
+               and f.line == hazard_line for f in fresh), \
+        [f.render() for f in fresh]
+
+    # ... and the CLI exits non-zero printing file:line + rule id
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.spmdcheck", "--root", str(tmp_path),
+         "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert (f"lightgbm_tpu/parallel/learners.py:{hazard_line}: SPM001"
+            in proc.stdout), proc.stdout
+
+
+def test_cli_clean_exit_zero():
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.spmdcheck", "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ---------------------------------------------------------------------------
+# 2. rule correctness on fixtures
+# ---------------------------------------------------------------------------
+def test_fixtures_match_expect_markers():
+    findings, by_rel = run_spmdcheck([FIXTURES], root=REPO)
+    got = {}
+    for f in findings:
+        got.setdefault(os.path.basename(f.file), set()).add((f.line, f.rule))
+    checked = 0
+    for name in sorted(os.listdir(FIXTURES)):
+        if not name.endswith(".py"):
+            continue
+        expected = _markers(os.path.join(FIXTURES, name))
+        actual = got.get(name, set())
+        assert actual == expected, (
+            f"{name}: expected {sorted(expected)}, got {sorted(actual)}")
+        checked += 1
+    assert checked >= 8     # pos+neg per rule
+
+
+def test_suppression_clears_finding(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import jax\n\n\n"
+        "def guarded(x, axis):\n"
+        "    if jax.lax.axis_index(axis) == 0:\n"
+        "        # spmdcheck: disable=SPM001 -- proven-safe by masking\n"
+        "        x = jax.lax.psum(x, axis)\n"
+        "    return x\n")
+    findings, _ = run_spmdcheck(["mod.py"], root=str(tmp_path))
+    assert not findings, [f.render() for f in findings]
+
+
+def test_baseline_roundtrip(tmp_path):
+    mod = tmp_path / "mod.py"
+    shutil.copy(os.path.join(FIXTURES, "spm001_pos.py"), mod)
+    findings, by_rel = run_spmdcheck(["mod.py"], root=str(tmp_path))
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), findings, by_rel)
+    again, by_rel2 = run_spmdcheck(["mod.py"], root=str(tmp_path))
+    assert not new_findings(again, by_rel2, load_baseline(str(bl_path)))
+    # a NEW hazard (distinct line text) surfaces through the pin
+    mod.write_text(mod.read_text() + (
+        "\n\ndef fresh_hazard(z, axis):\n"
+        "    if jax.lax.axis_index(axis) > 2:\n"
+        "        z = jax.lax.pmax(z, axis)\n"
+        "    return z\n"))
+    third, by_rel3 = run_spmdcheck(["mod.py"], root=str(tmp_path))
+    fresh = new_findings(third, by_rel3, load_baseline(str(bl_path)))
+    assert len(fresh) == 1 and fresh[0].rule == "SPM001", \
+        [f.render() for f in fresh]
+
+
+# ---------------------------------------------------------------------------
+# 3. schedule extraction
+# ---------------------------------------------------------------------------
+def test_schedule_dump_covers_distributed_learners():
+    """The static schedule walk must surface the wave collectives from
+    the shard_map roots — the same sites the runtime flight recorder
+    fingerprints."""
+    lines = "\n".join(render_schedules(["lightgbm_tpu"], root=REPO))
+    assert "parallel/learners.py" in lines, lines
+    assert "psum[device]" in lines, lines
+
+
+def test_schedule_extraction_orders_entries(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import jax\n\n\n"
+        "def helper(y, axis):\n"
+        "    return jax.lax.all_gather(y, axis)\n\n\n"
+        "def root(x, axis):\n"
+        "    a = jax.lax.psum(x, axis)\n"
+        "    b = helper(a, axis)\n"
+        "    return jax.lax.pmean(b, axis)\n\n\n"
+        "wrapped = jax.jit(root)\n")
+    from tools.spmdcheck.schedule import build_graph, extract_schedule
+    from tools.tpulint.core import discover_files
+    files = discover_files(["mod.py"], str(tmp_path))
+    functions, traced, _ = build_graph(files)
+    root_info = functions["mod.py::root"]
+    assert root_info.qualname in traced
+    ops = [e.op for e in extract_schedule(root_info, functions)]
+    assert ops == ["psum", "all_gather", "pmean"], ops

@@ -1,0 +1,69 @@
+"""spmdcheck — cross-rank collective-schedule analyzer.
+
+The static half of the PR-4 desync tooling (the runtime half is the
+collective flight recorder, ``lightgbm_tpu/obs/flight_recorder.py``):
+AST analysis over the package proving that no code path can make ranks
+issue different collective schedules — rules SPM001-SPM004, run as a
+tier-1 gate via ``tests/test_spmdcheck.py`` and by hand::
+
+    python -m tools.spmdcheck [--update-baseline] [--schedule] [paths...]
+
+Shares tpulint's parse cache, suppression syntax, and content-keyed
+baseline machinery (``tools/tpulint/core.py``); the combined tier-1
+static gate parses every file exactly once.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.tpulint.core import (FileInfo, Finding, count_keys,
+                                discover_files, load_baseline,
+                                new_findings, suppressed, write_baseline)
+
+from .rules import FILE_RULES, RULE_TITLES, SpmdContext, build_context
+from .schedule import extract_schedule, schedule_roots
+
+BASELINE_DEFAULT = os.path.join("tools", "spmdcheck", "baseline.json")
+
+__all__ = [
+    "run_spmdcheck", "Finding", "RULE_TITLES", "load_baseline",
+    "write_baseline", "new_findings", "BASELINE_DEFAULT",
+    "render_schedules",
+]
+
+
+def run_spmdcheck(paths: Sequence[str] = ("lightgbm_tpu",),
+                  root: Optional[str] = None,
+                  ) -> Tuple[List[Finding], Dict[str, FileInfo]]:
+    """Analyze ``paths``; returns (findings sorted by location, FileInfo
+    by relative path).  Inline suppressions applied; baseline is NOT —
+    callers diff via :func:`new_findings` (same contract as tpulint)."""
+    root = os.path.abspath(root or os.getcwd())
+    files = discover_files(paths, root)
+    ctx = build_context(files, root)
+    findings: List[Finding] = []
+    for fi in files:
+        for rule in FILE_RULES:
+            for f in rule(fi, ctx):
+                if not suppressed(fi, f):
+                    findings.append(f)
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings, ctx.by_rel
+
+
+def render_schedules(paths: Sequence[str] = ("lightgbm_tpu",),
+                     root: Optional[str] = None) -> List[str]:
+    """Human-readable collective schedule per jit/shard_map root and
+    host-collective seam function (the ``--schedule`` CLI dump)."""
+    root = os.path.abspath(root or os.getcwd())
+    files = discover_files(paths, root)
+    ctx = build_context(files, root)
+    lines: List[str] = []
+    for info in schedule_roots(ctx.functions, ctx.traced):
+        entries = extract_schedule(info, ctx.functions)
+        if not entries:
+            continue
+        lines.append(f"{info.qualname}:")
+        lines.extend(f"  {e.render()}" for e in entries)
+    return lines

@@ -1,0 +1,297 @@
+"""spmdcheck rules SPM001-SPM004 — cross-rank schedule hazards.
+
+tpulint (TPL001-TPL008) checks intra-rank hazards; these rules check
+the property tpulint cannot see: that every rank issues the IDENTICAL
+collective schedule.  The reference enforces it by construction —
+every machine runs the same split sequence and blocking socket
+collectives (`data_parallel_tree_learner.cpp:147-162`); a JAX port
+desyncs silently when trace-time Python branches on the rank.
+
+| id     | hazard                                                       |
+|--------|--------------------------------------------------------------|
+| SPM001 | collective under a rank-conditional branch (`axis_index`/    |
+|        | `process_index`-dependent test): ranks can skip or reorder   |
+|        | the schedule — deadlock or silent skew                       |
+| SPM002 | sibling branches both reach collectives but with DIFFERENT   |
+|        | (op, axis) sequences: whichever way the predicate resolves   |
+|        | per rank, the schedules cannot both be right                 |
+| SPM003 | rank-variant value feeding a collective operand SHAPE or a   |
+|        | loop trip count that issues collectives: per-rank shape /    |
+|        | call-count divergence (rank-variant VALUES are fine — that   |
+|        | is what collectives are for)                                 |
+| SPM004 | host collective primitive called outside the                 |
+|        | io/distributed.py / parallel/mesh.py seam (loses retry,      |
+|        | telemetry span, and flight-recorder fingerprinting)          |
+
+Suppression syntax is shared with tpulint
+(``# spmdcheck: disable=SPMxxx -- why`` or the ``tpulint:`` tag).
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set
+
+from tools.tpulint.callgraph import FunctionInfo, _callee_name
+from tools.tpulint.core import FileInfo, Finding
+from tools.tpulint.rules import JAX_ALIASES, NP_ALIASES, _root_name
+
+from .schedule import (Entry, _expr_tainted, build_graph, entry_for,
+                       rank_tainted, subtree_schedule,
+                       test_is_rank_dependent, walk_own)
+
+RULE_TITLES = {
+    "SPM001": "collective under rank-conditional control flow",
+    "SPM002": "sibling branches with mismatched collective schedules",
+    "SPM003": "rank-variant value feeds collective shape/trip count",
+    "SPM004": "host collective outside the retry/telemetry seam",
+}
+
+# the sanctioned host-collective seam modules (retry + span + flight
+# recorder wrap every primitive there)
+SEAM_SUFFIXES = ("io/distributed.py", "parallel/mesh.py")
+
+_SHAPE_FNS = {"zeros", "ones", "full", "empty", "arange", "broadcast_to",
+              "tile", "repeat", "reshape"}
+
+
+@dataclass
+class SpmdContext:
+    root: str
+    files: List[FileInfo]
+    by_rel: Dict[str, FileInfo]
+    functions: Dict[str, FunctionInfo]
+    traced: Set[str]
+    performing: Set[str]            # qualnames issuing collectives
+
+
+def build_context(files: Sequence[FileInfo], root: str) -> SpmdContext:
+    functions, traced, performing = build_graph(files)
+    return SpmdContext(root=root, files=list(files),
+                       by_rel={fi.rel: fi for fi in files},
+                       functions=functions, traced=traced,
+                       performing=performing)
+
+
+def _file_functions(fi: FileInfo, ctx: SpmdContext) -> List[FunctionInfo]:
+    return [info for info in ctx.functions.values() if info.fi.rel == fi.rel]
+
+
+class _ModuleScope:
+    """Module-level statements as a pseudo-function (a rank-guarded host
+    collective at import/module scope is the same hazard)."""
+
+    def __init__(self, fi: FileInfo):
+        self.fi = fi
+        self.node = fi.tree
+        self.name = "<module>"
+        self.qualname = f"{fi.rel}::<module>"
+
+
+def _scopes(fi: FileInfo, ctx: SpmdContext):
+    return [_ModuleScope(fi)] + _file_functions(fi, ctx)
+
+
+# -- SPM001 ---------------------------------------------------------------
+def rule_spm001(fi: FileInfo, ctx: SpmdContext) -> List[Finding]:
+    out: List[Finding] = []
+    for scope in _scopes(fi, ctx):
+        tainted = rank_tainted(scope.node)
+
+        def visit(node: ast.AST, cond_line: Optional[int]) -> None:
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue        # separate scope
+                branch_cond = cond_line
+                if isinstance(child, (ast.If, ast.While)):
+                    visit(child.test, cond_line)
+                    if test_is_rank_dependent(child.test, tainted):
+                        branch_cond = child.lineno
+                    for stmt in list(child.body) + list(child.orelse):
+                        visit(stmt, branch_cond)
+                    continue
+                if isinstance(child, ast.IfExp):
+                    visit(child.test, cond_line)
+                    sub = (child.lineno
+                           if test_is_rank_dependent(child.test, tainted)
+                           else cond_line)
+                    visit(child.body, sub)
+                    visit(child.orelse, sub)
+                    continue
+                _check(child, branch_cond)
+                visit(child, branch_cond)
+
+        def _check(node: ast.AST, cond_line: Optional[int]) -> None:
+            if cond_line is None or not isinstance(node, ast.Call):
+                return
+            e = entry_for(node, fi)
+            if e is not None:
+                out.append(Finding(
+                    fi.rel, node.lineno, "SPM001",
+                    f"collective `{e.op}` under a rank-conditional "
+                    f"branch (test at line {cond_line}): ranks take "
+                    f"different schedules — deadlock or silent skew; "
+                    f"hoist the collective out of the branch, or make "
+                    f"every rank issue it and mask the result"))
+
+        visit(scope.node, None)
+    return out
+
+
+# -- SPM002 ---------------------------------------------------------------
+def _seq_sig(entries: List[Entry]) -> List[str]:
+    return [f"{e.op}@{e.axis or '?'}" for e in entries]
+
+
+def rule_spm002(fi: FileInfo, ctx: SpmdContext) -> List[Finding]:
+    out: List[Finding] = []
+    for scope in _scopes(fi, ctx):
+        for node in walk_own(scope.node):
+            if not isinstance(node, ast.If) or not node.orelse:
+                continue
+            body_seq: List[Entry] = []
+            for stmt in node.body:
+                body_seq.extend(subtree_schedule(stmt, fi))
+            else_seq: List[Entry] = []
+            for stmt in node.orelse:
+                else_seq.extend(subtree_schedule(stmt, fi))
+            if not body_seq or not else_seq:
+                continue
+            bs, es = _seq_sig(body_seq), _seq_sig(else_seq)
+            if bs != es:
+                out.append(Finding(
+                    fi.rel, node.lineno, "SPM002",
+                    f"sibling branches reach different collective "
+                    f"schedules ({' -> '.join(bs)} vs "
+                    f"{' -> '.join(es)}): if the predicate can differ "
+                    f"across ranks the schedules desync; make the "
+                    f"branches issue the same (op, axis) sequence or "
+                    f"lift the collectives above the branch"))
+    return out
+
+
+# -- SPM003 ---------------------------------------------------------------
+def _subtree_has_collective(node: ast.AST, fi: FileInfo,
+                            ctx: SpmdContext) -> bool:
+    if subtree_schedule(node, fi):
+        return True
+    # calls to collective-performing package functions count too
+    performing_names = {ctx.functions[q].name for q in ctx.performing}
+    for sub in walk_own(node):
+        if isinstance(sub, ast.Call):
+            if _callee_name(sub.func) in performing_names:
+                return True
+    return False
+
+
+def _resolves_to_performing(arg: ast.AST, fi: FileInfo,
+                            ctx: SpmdContext) -> bool:
+    name = _callee_name(arg) if not isinstance(arg, ast.Name) else arg.id
+    if name is None:
+        return False
+    return any(ctx.functions[q].name == name for q in ctx.performing)
+
+
+def rule_spm003(fi: FileInfo, ctx: SpmdContext) -> List[Finding]:
+    out: List[Finding] = []
+    for scope in _scopes(fi, ctx):
+        tainted = rank_tainted(scope.node)
+        if not tainted:
+            continue
+        performs = (isinstance(scope, FunctionInfo)
+                    and scope.qualname in ctx.performing) \
+            or bool(subtree_schedule(scope.node, fi)
+                    if isinstance(scope, _ModuleScope) else False)
+        for node in walk_own(scope.node):
+            # (a) Python loop with a rank-variant trip count ISSUING
+            # collectives: per-rank collective counts diverge
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                it = node.iter
+                if (isinstance(it, ast.Call)
+                        and _callee_name(it.func) == "range"
+                        and any(_is_tainted(a, tainted) for a in it.args)
+                        and any(_subtree_has_collective(s, fi, ctx)
+                                for s in node.body)):
+                    out.append(Finding(
+                        fi.rel, node.lineno, "SPM003",
+                        "loop trip count is rank-variant and the body "
+                        "issues collectives: ranks issue different "
+                        "collective counts and desync; make the trip "
+                        "count uniform (sync a max first) or move the "
+                        "collective out of the loop"))
+            elif isinstance(node, ast.Call):
+                callee = _callee_name(node.func)
+                # (b) traced loop combinators with rank-variant trip
+                # counts around collective-issuing bodies
+                if callee in ("fori_loop", "scan", "while_loop"):
+                    bounds = list(node.args[:2])
+                    bounds += [kw.value for kw in node.keywords
+                               if kw.arg == "length"]
+                    body_args = [a for a in node.args[2:3]] or node.args[:1]
+                    if (any(_is_tainted(b, tainted) for b in bounds)
+                            and (performs
+                                 or any(_resolves_to_performing(a, fi, ctx)
+                                        for a in body_args))):
+                        out.append(Finding(
+                            fi.rel, node.lineno, "SPM003",
+                            f"`{callee}` trip count is rank-variant in "
+                            f"collective-issuing code: per-rank "
+                            f"schedules diverge; bound the loop by a "
+                            f"synced (uniform) count"))
+                # (c) rank-variant shape construction feeding the
+                # collective path (operand shapes must match rank-wide)
+                elif (callee in _SHAPE_FNS and performs
+                      and isinstance(node.func, ast.Attribute)
+                      and _root_name(node.func) in (NP_ALIASES
+                                                    | JAX_ALIASES)):
+                    shape_args = list(node.args[:1]) if callee != "arange" \
+                        else list(node.args)
+                    shape_args += [kw.value for kw in node.keywords
+                                   if kw.arg == "shape"]
+                    if any(_is_tainted(a, tainted) for a in shape_args):
+                        out.append(Finding(
+                            fi.rel, node.lineno, "SPM003",
+                            f"`{callee}` builds a rank-variant SHAPE in "
+                            f"collective-issuing code: collective "
+                            f"operand shapes must be identical on every "
+                            f"rank (XLA rejects the lucky ones, DCN "
+                            f"corrupts the rest); pad to a synced max "
+                            f"like io/distributed.py does"))
+    return out
+
+
+def _is_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    return _expr_tainted(expr, tainted)
+
+
+# -- SPM004 ---------------------------------------------------------------
+def rule_spm004(fi: FileInfo, ctx: SpmdContext) -> List[Finding]:
+    if fi.rel.endswith(SEAM_SUFFIXES):
+        return []
+    out: List[Finding] = []
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = _callee_name(node.func)
+        prim = None
+        if name == "process_allgather":
+            prim = "multihost_utils.process_allgather"
+        elif (name == "initialize" and isinstance(node.func, ast.Attribute)
+                and isinstance(node.func.value, ast.Attribute)
+                and node.func.value.attr == "distributed"):
+            prim = "jax.distributed.initialize"
+        if prim is not None:
+            out.append(Finding(
+                fi.rel, node.lineno, "SPM004",
+                f"{prim} called outside the io/distributed.py / "
+                f"parallel/mesh.py seam: the call skips the shared "
+                f"retry policy, the telemetry span, and the flight-"
+                f"recorder fingerprint; route through "
+                f"jax_process_allgather / init_distributed"))
+    return out
+
+
+FILE_RULES: List[Callable[[FileInfo, SpmdContext], List[Finding]]] = [
+    rule_spm001, rule_spm002, rule_spm003, rule_spm004,
+]

@@ -1,0 +1,298 @@
+"""Collective-schedule extraction — the static half of spmdcheck.
+
+The SPMD contract (reference: every machine executes the identical
+split sequence, `data_parallel_tree_learner.cpp:147-162`) translates in
+the JAX port to: **every rank must issue the same ordered sequence of
+collectives with the same axes and operand shapes**.  GSPMD gets this
+for free inside one ``shard_map`` program; the hazard lives in the
+Python that *builds* the program (rank-conditional trace-time control
+flow) and in the host-collective layer (``io/distributed.py``), where
+nothing checks it.
+
+This module extracts that schedule statically: for every function (and
+transitively from every ``jit``/``shard_map`` root via tpulint's
+call-graph walker) the ordered list of collective call sites —
+``(op, kind, axis, operand, file, line)`` — in source-evaluation order.
+``rules.py`` consumes per-function schedules; the CLI ``--schedule``
+flag dumps the per-root walk for humans.
+
+Shares tpulint's parsed-AST cache (``tools.tpulint.core``): running
+both gates in one process parses every file once.
+"""
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.tpulint.callgraph import (FunctionInfo, _callee_name,
+                                     compute_traced)
+from tools.tpulint.core import FileInfo
+
+# XLA collective primitives issued inside traced code (jax.lax.*)
+DEVICE_COLLECTIVES = {
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "ppermute", "psum_scatter", "pshuffle", "pbroadcast",
+}
+# host-side collective primitives (DCN; one call per process)
+HOST_PRIMITIVES = {"process_allgather"}
+# package seam functions that PERFORM a host collective when called —
+# calling these is the sanctioned way to touch the DCN (retry +
+# telemetry + flight recorder ride along)
+HOST_WRAPPERS = {"jax_process_allgather", "find_bins_distributed",
+                 "merged_summary"}
+# calls producing RANK-VARIANT values (process_count/axis_size are
+# deliberately absent: they are uniform across ranks)
+RANK_SOURCES = {"axis_index", "process_index"}
+
+
+@dataclass(frozen=True)
+class Entry:
+    """One collective call site, in schedule order."""
+    op: str                     # "psum", "process_allgather", ...
+    kind: str                   # "device" | "host"
+    axis: Optional[str]         # unparsed axis expression, if present
+    operand: Optional[str]      # unparsed first operand (truncated)
+    file: str                   # root-relative path
+    line: int
+
+    def render(self) -> str:
+        ax = f" axis={self.axis}" if self.axis else ""
+        opnd = f" operand={self.operand}" if self.operand else ""
+        return f"{self.file}:{self.line}: {self.op}[{self.kind}]{ax}{opnd}"
+
+
+def _unparse(node: ast.AST, limit: int = 40) -> Optional[str]:
+    try:
+        s = ast.unparse(node)
+    except Exception:       # tpulint: disable=TPL006 -- best-effort label
+        return None
+    return s if len(s) <= limit else s[:limit - 3] + "..."
+
+
+def collective_call(node: ast.AST) -> Optional[Tuple[str, str]]:
+    """(op, kind) when ``node`` is a collective call, else None.  Name
+    matching is deliberately coarse (tpulint's philosophy): a bare
+    ``psum``/``all_gather`` callee counts wherever it appears."""
+    if not isinstance(node, ast.Call):
+        return None
+    name = _callee_name(node.func)
+    if name in DEVICE_COLLECTIVES:
+        return name, "device"
+    if name in HOST_PRIMITIVES:
+        return name, "host"
+    if name in HOST_WRAPPERS:
+        return name, "host"
+    if (name == "initialize" and isinstance(node.func, ast.Attribute)
+            and isinstance(node.func.value, ast.Attribute)
+            and node.func.value.attr == "distributed"):
+        return "distributed.initialize", "host"
+    return None
+
+
+def entry_for(node: ast.Call, fi: FileInfo) -> Optional[Entry]:
+    ck = collective_call(node)
+    if ck is None:
+        return None
+    op, kind = ck
+    axis = None
+    operand = None
+    if kind == "device":
+        if len(node.args) >= 2:
+            axis = _unparse(node.args[1])
+        for kw in node.keywords:
+            if kw.arg in ("axis_name", "axis"):
+                axis = _unparse(kw.value)
+        if node.args:
+            operand = _unparse(node.args[0])
+    return Entry(op=op, kind=kind, axis=axis, operand=operand,
+                 file=fi.rel, line=node.lineno)
+
+
+def walk_own(fn_node: ast.AST) -> Iterable[ast.AST]:
+    """Evaluation-ordered walk of a function body EXCLUDING nested
+    ``def`` subtrees but INCLUDING lambdas — a lambda handed to
+    ``jax.tree.map`` executes inline in the enclosing schedule (the
+    ``_sync_global_best`` pattern), a nested ``def`` is its own node.
+    Calls yield AFTER their argument subtrees (operands evaluate
+    first), matching runtime collective issue order."""
+    for child in ast.iter_child_nodes(fn_node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield from walk_own(child)
+        yield child
+
+
+def function_schedule(info: FunctionInfo) -> List[Entry]:
+    """Ordered collective entries issued directly by ``info``'s own
+    body (nested defs excluded — they are separate schedule units)."""
+    out: List[Entry] = []
+    for node in walk_own(info.node):
+        if isinstance(node, ast.Call):
+            e = entry_for(node, info.fi)
+            if e is not None:
+                out.append(e)
+    return out
+
+
+def subtree_schedule(node: ast.AST, fi: FileInfo) -> List[Entry]:
+    """Ordered collective entries under an arbitrary statement subtree
+    (used for branch-schedule comparison), nested defs excluded."""
+    out: List[Entry] = []
+    for sub in walk_own(node):
+        if isinstance(sub, ast.Call):
+            e = entry_for(sub, fi)
+            if e is not None:
+                out.append(e)
+    # the subtree ROOT itself (walk_own yields children only)
+    if isinstance(node, ast.Call):
+        e = entry_for(node, fi)
+        if e is not None:
+            out.append(e)
+    return out
+
+
+# -- rank-variance taint --------------------------------------------------
+def _expr_tainted(expr: ast.AST, tainted: Set[str]) -> bool:
+    for node in ast.walk(expr):
+        if isinstance(node, ast.Call):
+            if _callee_name(node.func) in RANK_SOURCES:
+                return True
+        elif (isinstance(node, ast.Name) and isinstance(node.ctx, ast.Load)
+                and node.id in tainted):
+            return True
+    return False
+
+
+def _target_names(target: ast.AST) -> List[str]:
+    if isinstance(target, ast.Name):
+        return [target.id]
+    if isinstance(target, (ast.Tuple, ast.List)):
+        out: List[str] = []
+        for el in target.elts:
+            out.extend(_target_names(el))
+        return out
+    return []
+
+
+def rank_tainted(fn_node: ast.AST) -> Set[str]:
+    """Local names carrying rank-variant values: assigned (directly or
+    transitively) from ``axis_index()``/``process_index()``.  A simple
+    fixpoint over straight-line assignments — deliberately coarse, no
+    kill-set (a name once rank-variant stays suspect)."""
+    tainted: Set[str] = set()
+    changed = True
+    while changed:
+        changed = False
+        for node in walk_own(fn_node):
+            value = None
+            targets: List[str] = []
+            if isinstance(node, ast.Assign):
+                value = node.value
+                for t in node.targets:
+                    targets.extend(_target_names(t))
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                value = node.value
+                targets = _target_names(node.target)
+            elif isinstance(node, ast.AugAssign):
+                value = node.value
+                targets = _target_names(node.target)
+            elif isinstance(node, ast.NamedExpr):
+                value = node.value
+                targets = _target_names(node.target)
+            elif isinstance(node, (ast.For, ast.AsyncFor)):
+                value = node.iter
+                targets = _target_names(node.target)
+            if value is None or not targets:
+                continue
+            if _expr_tainted(value, tainted):
+                new = set(targets) - tainted
+                if new:
+                    tainted |= new
+                    changed = True
+    return tainted
+
+
+def test_is_rank_dependent(test: ast.AST, tainted: Set[str]) -> bool:
+    return _expr_tainted(test, tainted)
+
+
+# -- collective-performing propagation ------------------------------------
+def performing_functions(functions: Dict[str, FunctionInfo]) -> Set[str]:
+    """Qualnames of functions that (transitively) issue a collective:
+    own body contains one, or they call (by bare name — same coarse
+    resolution as the traced-set walk) a performing function."""
+    by_name: Dict[str, List[FunctionInfo]] = {}
+    for info in functions.values():
+        by_name.setdefault(info.name, []).append(info)
+    performing: Set[str] = {
+        q for q, info in functions.items() if function_schedule(info)}
+    # reverse edges: callee name -> caller qualnames
+    callers: Dict[str, List[str]] = {}
+    for q, info in functions.items():
+        for callee in info.called:
+            callers.setdefault(callee, []).append(q)
+    work = [functions[q].name for q in performing]
+    while work:
+        name = work.pop()
+        for caller_q in callers.get(name, []):
+            if caller_q not in performing:
+                performing.add(caller_q)
+                work.append(functions[caller_q].name)
+    return performing
+
+
+# -- root schedule walk (the CLI --schedule dump) -------------------------
+def extract_schedule(root: FunctionInfo,
+                     functions: Dict[str, FunctionInfo],
+                     _visited: Optional[Set[str]] = None,
+                     _depth: int = 0) -> List[Entry]:
+    """Ordered collective schedule along every path from ``root``:
+    own-body collectives in evaluation order, with calls to local
+    functions expanded inline (coarse name resolution, cycle-guarded).
+    This is the static mirror of what the runtime flight recorder
+    (``lightgbm_tpu/obs/flight_recorder.py``) captures at trace time."""
+    visited = _visited if _visited is not None else set()
+    if root.qualname in visited or _depth > 12:
+        return []
+    visited.add(root.qualname)
+    by_name: Dict[str, List[FunctionInfo]] = {}
+    for info in functions.values():
+        by_name.setdefault(info.name, []).append(info)
+    out: List[Entry] = []
+    for node in walk_own(root.node):
+        if not isinstance(node, ast.Call):
+            continue
+        e = entry_for(node, root.fi)
+        if e is not None:
+            out.append(e)
+            continue
+        callee = _callee_name(node.func)
+        if callee is None:
+            continue
+        # prefer same-file definitions; fall back to any package match
+        cands = [i for i in by_name.get(callee, [])
+                 if i.fi.rel == root.fi.rel] or by_name.get(callee, [])
+        for info in cands[:1]:
+            out.extend(extract_schedule(info, functions, visited,
+                                        _depth + 1))
+    return out
+
+
+def schedule_roots(functions: Dict[str, FunctionInfo],
+                   traced: Set[str]) -> List[FunctionInfo]:
+    """Entry points worth dumping: jit/shard_map roots plus host
+    collective seam functions (they anchor the host schedule)."""
+    roots = [info for q, info in functions.items()
+             if info.is_root and q in traced]
+    roots += [info for info in functions.values()
+              if info.name in HOST_WRAPPERS and not info.is_root]
+    return sorted(roots, key=lambda i: (i.fi.rel, i.node.lineno))
+
+
+def build_graph(files: Sequence[FileInfo]
+                ) -> Tuple[Dict[str, FunctionInfo], Set[str], Set[str]]:
+    """(functions by qualname, traced qualnames, performing qualnames) —
+    one call-graph build shared by every rule."""
+    functions, traced = compute_traced(files)
+    return functions, traced, performing_functions(functions)

@@ -33,8 +33,11 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 
 BASELINE_DEFAULT = os.path.join("tools", "tpulint", "baseline.json")
 
+# one parse serves both static gates: spmdcheck (tools/spmdcheck) shares
+# the suppression syntax under its own tag
 _SUPPRESS_RE = re.compile(
-    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?\s*$")
+    r"#\s*(?:tpulint|spmdcheck):\s*disable="
+    r"([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?\s*$")
 
 
 @dataclass(frozen=True)
