@@ -163,11 +163,62 @@ def _empty_best(L: int, B: int) -> SplitResult:
 # ---------------------------------------------------------------------------
 # histogram-wave strategies (the learner-type seam, tree_learner.cpp:9-33)
 # ---------------------------------------------------------------------------
+def uses_pallas(backend: str) -> bool:
+    """Whether this backend runs the Pallas kernel family ("compact" is
+    the wide kernel + leaf-compacted deep waves, not a separate kernel
+    stack — routing, fusion, and bins_t prep are shared)."""
+    return backend in ("pallas", "compact")
+
+
+def _pallas_interpret() -> bool:
+    """Pallas kernels run in interpret mode off-TPU (CPU oracle tests /
+    forced-backend runs); compiled on the real device."""
+    return jax.default_backend() != "tpu"
+
+
+def wave_uses_compact(backend: str, num_slots: int) -> bool:
+    """THE per-wave dispatch predicate: a wave whose active-slot count
+    exceeds the compaction threshold takes the leaf-compacted kernel on
+    the "compact" backend.  Slot counts are static per wave (stage_plan
+    unrolled stages + the fixed-width tail), so this resolves at trace
+    time — shallow waves keep the wide (fused) kernel with zero runtime
+    branching."""
+    from ..ops.compact import compact_slot_threshold
+    return backend == "compact" and num_slots > compact_slot_threshold()
+
+
+def wave_backend_plan(L: int, wave_size: int = 0, backend: str = "compact",
+                      fused_ok: bool = True):
+    """Per-wave kernel choice for a stage plan: ``-> (choices, tail)``
+    with entries "compact" / "fused" / "<backend>".  Pure mirror of the
+    dispatch :func:`build_tree` applies (same ``wave_uses_compact``
+    predicate), exposed so tests can pin the selection without tracing
+    a tree build."""
+    plan, A_tail = stage_plan(L, wave_size)
+
+    def choice(A: int) -> str:
+        if wave_uses_compact(backend, A):
+            return "compact"
+        if uses_pallas(backend) and fused_ok:
+            return "fused"
+        return backend
+
+    return [choice(A) for A in plan], choice(A_tail)
+
+
 def resolve_backend(data: DeviceData, num_leaf_slots: int,
                     backend: str = "auto", hist_mode: str = "hilo") -> str:
     if backend == "auto":
         backend = default_backend()
-    if backend == "pallas" and not pallas_config_ok(
+    if backend == "compact":
+        from ..ops.compact import compact_config_ok, compact_slot_threshold
+        _, A_tail = stage_plan(num_leaf_slots)
+        if (A_tail <= compact_slot_threshold()
+                or not compact_config_ok(data.group_max_bins, hist_mode)):
+            # shallow trees never reach the slot threshold (and a
+            # VMEM-infeasible group cell can't run): plain wide kernel
+            backend = "pallas"
+    if uses_pallas(backend) and not pallas_config_ok(
             data.group_max_bins, num_leaf_slots, hist_mode):
         backend = "scatter"     # >256 bins or VMEM-infeasible config
     return backend
@@ -233,7 +284,7 @@ def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
         hist_mode = default_hist_mode()
     hist_mode = effective_hist_mode(hist_mode, data.num_data)
     backend = resolve_backend(data, num_leaf_slots, backend, hist_mode)
-    if backend == "pallas":
+    if uses_pallas(backend):
         if bins_t is None:
             bins_t = transpose_bins(data.bins)
         if is_quantized(hist_mode):
@@ -242,15 +293,28 @@ def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
             vals, scales = pack_values(grad, hess, hist_mode), None
         n_pad = bins_t.shape[1]
         n = data.bins.shape[0]
+        interp = _pallas_interpret()
+        # resolved once: the per-wave choice below keys only on the
+        # wave's static slot count
+        from ..ops import compact as compact_mod
 
         def hist_fn(hist_leaf, active):
             leaf = hist_leaf
             if leaf.shape[0] != n_pad:
                 leaf = jnp.pad(leaf[:n], (0, n_pad - n), constant_values=-1)
+            if wave_uses_compact(backend, active.shape[0]):
+                # deep wave: leaf-compacted regroup + grouped kernel
+                # (ops/compact.py) — per-row MXU work independent of A
+                return compact_mod.hist_active_compact(
+                    bins_t, vals, leaf, active, scales,
+                    num_features=data.num_groups,
+                    max_bins=data.group_max_bins,
+                    num_leaf_slots=num_leaf_slots, mode=hist_mode,
+                    interpret=interp)
             return hist_active_pallas(
                 bins_t, vals, leaf, active, scales,
                 num_features=data.num_groups, max_bins=data.group_max_bins,
-                mode=hist_mode)
+                mode=hist_mode, interpret=interp)
     else:
         n = data.bins.shape[0]
 
@@ -268,9 +332,10 @@ def make_route_fn(data: DeviceData, backend: str,
     -> leaf2`` (the DataPartition::Split analog).  A ``lax.cond`` skips
     the full-data pass when no splits are pending (the root wave and
     drained tail waves)."""
-    if backend == "pallas":
+    if uses_pallas(backend):
         if bins_t is None:
             bins_t = transpose_bins(data.bins)
+        interp = _pallas_interpret()
 
         def route_impl(leaf2, best: SplitResult, sel, new_id):
             return route_rows_pallas(
@@ -278,7 +343,8 @@ def make_route_fn(data: DeviceData, backend: str,
                 best.default_left, best.is_categorical, best.cat_mask,
                 sel, new_id, data.missing_types, data.nan_bins,
                 data.default_bins, data.feat_group, data.feat_offset,
-                data.num_bins, any_cat=data.has_categorical)
+                data.num_bins, any_cat=data.has_categorical,
+                interpret=interp)
     else:
         def route_impl(leaf2, best: SplitResult, sel, new_id):
             return route_rows_xla(
@@ -329,6 +395,8 @@ def make_fused_fn(data: DeviceData, grad, hess, hist_mode: str,
     else:
         vals, scales = pack_values(grad, hess, hist_mode), None
 
+    interp = _pallas_interpret()
+
     def fused(leaf2, best: SplitResult, sel, new_id, active):
         h, leaf2_new = hist_route_pallas(
             bins_t, vals, leaf2, active,
@@ -337,7 +405,8 @@ def make_fused_fn(data: DeviceData, grad, hess, hist_mode: str,
             data.missing_types, data.nan_bins, data.default_bins,
             data.feat_group, data.feat_offset, data.num_bins, scales,
             num_features=data.num_groups, max_bins=data.group_max_bins,
-            mode=hist_mode, any_cat=data.has_categorical)
+            mode=hist_mode, any_cat=data.has_categorical,
+            interpret=interp)
         return h, leaf2_new
     return fused
 
@@ -429,13 +498,13 @@ def build_tree(data: DeviceData,
 
     mode = effective_hist_mode(hist_mode or default_hist_mode(), n)
     backend = resolve_backend(data, L, hist_backend, mode)
-    if backend == "pallas" and bins_t is None:
+    if uses_pallas(backend) and bins_t is None:
         bins_t = transpose_bins(data.bins)
 
     # staged waves only pay off on the Pallas path (MXU cost ∝ slots);
     # the scatter backend compiles one while-loop body instead (8 unrolled
     # stages × shard_map × 3 learners is minutes of XLA-CPU compile time)
-    if backend == "pallas":
+    if uses_pallas(backend):
         plan, A_tail = stage_plan(L, params.wave_size)
         # compile-lean: on small datasets the staged unrolled waves buy
         # nothing (MXU cost ∝ slots×n is trivial) but multiply HLO size
@@ -452,18 +521,20 @@ def build_tree(data: DeviceData,
     # update) on any serial Pallas path — captured BEFORE the serial
     # strategy closure is assigned below
     emit_values = (strategy is None and psum_fn is None
-                   and backend == "pallas")
+                   and uses_pallas(backend))
     # fused route+hist: one bins stream per wave (serial Pallas path with
     # every stored column in a single kernel tile);
     # LGBM_TPU_NO_FUSED=1 forces the unfused path (A/B debugging)
     import os as _os
-    fused = (strategy is None and psum_fn is None and backend == "pallas"
+    fused = (strategy is None and psum_fn is None and uses_pallas(backend)
              and not _os.environ.get("LGBM_TPU_NO_FUSED")
              and fused_config_ok(bins_t.shape[0], data.group_max_bins, L,
                                  mode))
     fused_fn = (make_fused_fn(data, grad, hess, mode, bins_t)
                 if fused else None)
-    if strategy is None and not fused:
+    # the "compact" backend needs the strategy (route + compacted hist)
+    # for its deep waves even when the shallow waves run fused
+    if strategy is None and (not fused or backend == "compact"):
         strategy = make_serial_strategy(data, grad, hess, params,
                                         feature_mask, psum_fn=psum_fn,
                                         backend=backend, bins_t=bins_t,
@@ -483,7 +554,13 @@ def build_tree(data: DeviceData,
         # --- 0-3: apply last wave's pending splits to the rows, then
         # histogram the active leaves, subtract siblings, rescan.  The
         # fused kernel does the route inside the histogram's bins stream.
-        if fused:
+        # stage_plan-aware dispatch: the wave's slot count is static, so
+        # deep waves (> compaction threshold on the "compact" backend)
+        # trace the route + leaf-compacted grouped kernel while shallow
+        # waves keep the wide fused kernel (wave_uses_compact — the same
+        # predicate make_hist_fn applies inside the strategy)
+        if fused and not wave_uses_compact(backend,
+                                           s.act_small.shape[0]):
             new_h, leaf2 = fused_fn(s.leaf2, s.best, s.pend_sel,
                                     s.pend_new, s.act_small)
             hist_state, ids, res = scan_changed(
@@ -520,7 +597,7 @@ def build_tree(data: DeviceData,
             final.best.cat_mask, final.pend_sel, final.pend_new,
             data.missing_types, data.nan_bins, data.default_bins,
             data.feat_group, data.feat_offset, data.num_bins, lv_final,
-            any_cat=data.has_categorical)
+            any_cat=data.has_categorical, interpret=_pallas_interpret())
         row_value = row_value[:n]
     else:
         leaf2_final = route_fn(final.leaf2, final.best, final.pend_sel,
@@ -549,7 +626,7 @@ def _init_state(data: DeviceData, grad, hess, params: GrowthParams,
     Bh = bin_stride(data.group_max_bins)           # stored-column stride
     Gh = (num_hist_features if num_hist_features is not None
           else data.num_groups)
-    n_pad = bins_t.shape[1] if backend == "pallas" else n
+    n_pad = bins_t.shape[1] if uses_pallas(backend) else n
 
     row_leaf0 = jnp.zeros(n, jnp.int32)
     hist_leaf0 = (jnp.where(bag_mask, 0, -1).astype(jnp.int32)
@@ -635,7 +712,7 @@ def make_phases_driver(data: DeviceData,
     L = params.num_leaves
     mode = effective_hist_mode(hist_mode or default_hist_mode(), n)
     backend = resolve_backend(data, L, hist_backend, mode)
-    if backend == "pallas" and bins_t is None:
+    if uses_pallas(backend) and bins_t is None:
         bins_t = jax.jit(transpose_bins)(data.bins)
     _, A_tail = stage_plan(L, params.wave_size)
     wave_cap = params.wave_size if params.wave_size > 0 else L

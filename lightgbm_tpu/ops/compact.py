@@ -1,0 +1,304 @@
+"""Leaf-compacted deep-wave histograms — the TPU ``DataPartition`` analog.
+
+Why: per-row MXU work in the wide one-hot kernel
+(`ops/pallas_histogram.py`) scales with ``cols = round128(C *
+round8(A))`` — every row is contracted against the value columns of ALL
+``A`` active leaf slots even though it contributes to exactly one.
+``tests/data/north_star.json`` quantifies the collapse on the bench
+device: 1.08–1.13 ns/row at A <= 32 degrades to 2.55 at 64 and 8.79 at
+128 (MXU util 1.18 -> 0.61) — and 128-slot waves are the dominant
+regime of the reference's 255-leaf headline configs (the 0.27x ranking
+leg, README).  The reference solves the same problem on CPU with
+``DataPartition``'s leaf-contiguous row layout + ordered gradients
+(`/root/reference/src/treelearner/data_partition.hpp`,
+`serial_tree_learner.cpp` ordered-bin path): each leaf's histogram only
+ever touches that leaf's rows.
+
+This module is the TPU-native analog, in three steps per deep wave:
+
+1. **plan** (:func:`compact_plan`, plain XLA): bucket every row by its
+   active-slot *group* (``COMPACT_GROUP = 32`` slots per group — the
+   measured flat-regime boundary), stable-sort rows by group, and pad
+   each group's segment to a whole number of row tiles.  Rows whose
+   leaf is not active (bagged-out ``-1`` included) sort into a trailing
+   trash segment and are DROPPED from the compacted stream — deep
+   waves histogram only the smaller children, so this alone removes
+   the ~half of the stream the wide kernel reads and multiplies by
+   zero.
+2. **regroup**: one gather applies the permutation to the bins/value
+   streams.  It rides the wave's existing pending-split application:
+   the routed ``leaf2`` from `ops/pallas_route.py` (whose kernel has
+   already streamed the bins once to apply the previous wave's splits)
+   is consumed directly, so the plan adds no extra leaf computation —
+   the learner (`learner/serial.py`) routes, then compacts from the
+   routed vector.
+3. **grouped kernel** (:func:`hist_active_compact`): the one-hot matmul
+   kernel runs over the compacted stream with a *per-tile* active set
+   of ``COMPACT_GROUP`` slots — ``cols = round128(C * 32)`` instead of
+   ``round128(C * 128)`` — restoring the flat ~1.1 ns/row profile.
+   Each tile's group (and so its output block and its slice of the
+   per-group active table) is selected by a scalar-prefetched
+   ``tile_group`` vector (`pltpu.PrefetchScalarGridSpec`): segments
+   are group-contiguous, so every output block is visited in one
+   consecutive run and plain ``@pl.when(first-tile-of-group)``
+   zero-init + VMEM accumulation works exactly like the wide kernel's
+   row grid.
+
+Cost model: the wide kernel pays ``n * cols_wide`` MACs; the compacted
+path pays ``~n_active * cols_group`` MACs plus a stable segment-sort of
+an ``[n]`` int32 key and one bins/vals gather.  At A=128 / C=4 that is
+a 4x MAC reduction on <= ~half the rows; the sort+gather are measured
+per-device by the wave microbench (`bench.py` ``wave_kernel`` table),
+which records ns/row per active-slot bucket so this regression class
+stays visible in every ``BENCH_r*.json``.
+
+Exactness: identical quantized inputs accumulate in int32 exactly in
+both kernels, so the compacted path is BIT-identical to the wide
+kernel on the default int8 modes; float modes differ from the scatter
+oracle only by f32 summation order (tests pin bit-exactness with
+dyadic-rational values, tolerance otherwise).
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from .pallas_histogram import (DEFAULT_ROW_TILE, _VMEM_BUDGET_BYTES,
+                               _cell_vmem_bytes, _col_layout, _feat_tile_cap,
+                               _onehot_bins, _pick_row_tile, _round_up,
+                               _weighted_cols, bin_stride, combine_hist_cols,
+                               is_quantized)
+
+# leaf slots per compacted tile group.  32 is the measured flat-regime
+# boundary of the wide kernel (north_star.json: 1.13 ns/row at 32 vs
+# 8.79 at 128) — and for the default C=4 int8h mode, C*32 = 128 fills
+# the lane dimension exactly, so no output column is wasted.
+COMPACT_GROUP = 32
+
+
+def compact_slot_threshold() -> int:
+    """Waves with more active slots than this take the compacted path
+    (env-tunable for A/B: ``LGBM_TPU_COMPACT_SLOTS``)."""
+    return int(os.environ.get("LGBM_TPU_COMPACT_SLOTS", COMPACT_GROUP))
+
+
+def compact_config_ok(max_bins: int, mode: str) -> bool:
+    """VMEM feasibility of the grouped kernel: the per-grid-cell model
+    of the wide kernel (`pallas_histogram._cell_vmem_bytes`) extended
+    to the compacted cell — same resident arrays at the group column
+    count, plus the (negligible) [G, 1] group-active slice and [1, T]
+    compacted leaf row, at the 1024-row fallback tile."""
+    B = bin_stride(max_bins)
+    C, _, cols = _col_layout(COMPACT_GROUP, mode)
+    extra = COMPACT_GROUP * 4 + 2 * 1024 * 4   # group actives + leaf row
+    return _cell_vmem_bytes(8, B, cols, 1024, C) + extra <= _VMEM_BUDGET_BYTES
+
+
+def compact_plan(hist_leaf: jnp.ndarray, active: jnp.ndarray,
+                 num_leaf_slots: int, row_tile: int):
+    """Leaf-compaction plan for one wave: ``-> (src, tile_group,
+    group_active)``.
+
+    Args:
+      hist_leaf: ``[n_pad]`` int32 leaf per row (bagged-out/padding
+        rows carry ``-1``) — the ROUTED vector, i.e. the wave's pending
+        splits have already been applied by the route kernel.
+      active: ``[A]`` int32 active leaf ids (``-1`` padding).
+      num_leaf_slots: static leaf-slot count L (bounds the inverse
+        lookup table).
+      row_tile: the kernel's row-tile T; every group segment pads to a
+        multiple of it, and every group keeps >= 1 tile so its output
+        block is always zero-initialized (an unvisited block would
+        hand garbage to an active-but-empty leaf, e.g. bagged to 0
+        rows).
+
+    Returns:
+      src: ``[n_c]`` int32 — source row for each compacted row, ``-1``
+        for segment padding; ``n_c = n_pad + n_groups * T`` (static).
+      tile_group: ``[n_c // T]`` int32 — the group each row tile
+        serves, non-decreasing; tiles past the used region map to the
+        trailing trash group ``n_groups``.
+      group_active: ``[G, n_groups + 1]`` int32 — per-group active-leaf
+        table (column g = slots ``[g*G, (g+1)*G)``), ``-2`` padding so
+        neither real leaves nor the ``-1`` of padding rows match.
+    """
+    n_pad = hist_leaf.shape[0]
+    A = active.shape[0]
+    G = COMPACT_GROUP
+    T = row_tile
+    n_groups = -(-A // G)
+    L = num_leaf_slots
+
+    # slot of each row in the active list; A = inactive/bagged-out
+    safe_act = jnp.where(active >= 0, active, L)
+    inv = jnp.full((L + 1,), A, jnp.int32).at[safe_act].set(
+        jnp.arange(A, dtype=jnp.int32), mode="drop")
+    slot = jnp.where(hist_leaf >= 0,
+                     inv[jnp.clip(hist_leaf, 0, L - 1)], A)      # [n_pad]
+    grp = jnp.where(slot < A, slot // G, n_groups)
+
+    # stable segment sort by group: rows keep dataset order inside a
+    # group (the reference's leaf-contiguous index layout)
+    order = jnp.argsort(grp, stable=True)
+    sorted_grp = grp[order]
+    cnt = jnp.bincount(grp, length=n_groups + 1)[:n_groups]
+    pc = jnp.maximum(((cnt + T - 1) // T) * T, T)    # >= 1 tile per group
+    pstart = jnp.concatenate(
+        [jnp.zeros(1, pc.dtype), jnp.cumsum(pc)])    # [n_groups + 1]
+    ustart = jnp.concatenate(
+        [jnp.zeros(1, cnt.dtype), jnp.cumsum(cnt)])  # unpadded starts
+    rank = (jnp.arange(n_pad, dtype=jnp.int32)
+            - ustart[jnp.clip(sorted_grp, 0, n_groups)])
+    n_c = n_pad + n_groups * T                       # static bound
+    dst = jnp.where(sorted_grp < n_groups,
+                    pstart[jnp.clip(sorted_grp, 0, n_groups - 1)] + rank,
+                    n_c)                             # trash rows: dropped
+    src = jnp.full((n_c,), -1, jnp.int32).at[dst].set(
+        order.astype(jnp.int32), mode="drop")
+
+    # tile -> group.  Group starts are non-decreasing and empty groups
+    # are zero-width, so "last group starting at or before this tile"
+    # is the occupier; tiles past the used region land on the trash
+    # block n_groups (searchsorted returns n_groups + 1 there).
+    t0 = jnp.arange(n_c // T, dtype=pstart.dtype) * T
+    tile_group = (jnp.searchsorted(pstart, t0, side="right")
+                  .astype(jnp.int32) - 1)
+    tile_group = jnp.clip(tile_group, 0, n_groups)
+
+    ga = jnp.full(((n_groups + 1) * G,), -2, jnp.int32)
+    ga = jax.lax.dynamic_update_slice(
+        ga, jnp.where(active >= 0, active, -2).astype(jnp.int32), (0,))
+    group_active = ga.reshape(n_groups + 1, G).T     # [G, n_groups + 1]
+    return src, tile_group, group_active
+
+
+def _hist_compact_kernel(tg_ref, ga_ref, bins_ref, vals_ref, leaf_ref,
+                         out_ref, *, n_cols: int, B: int, pad_cols: int):
+    """One (feature-tile, row-tile) cell of the grouped kernel.  Same
+    body as the wide ``_hist_kernel`` at the group's column count; the
+    accumulator zero-init fires on the first tile of each group run
+    (groups are tile-contiguous, so each output block is one
+    consecutive visit)."""
+    i = pl.program_id(1)
+    prev = tg_ref[jnp.maximum(i - 1, 0)]
+    first = jnp.logical_or(i == 0, tg_ref[i] != prev)
+
+    @pl.when(first)
+    def _():
+        out_ref[:] = jnp.zeros_like(out_ref)
+
+    quant = vals_ref.dtype == jnp.int8
+    cdt = jnp.int8 if quant else jnp.bfloat16
+    oh = _onehot_bins(bins_ref[:].astype(jnp.int32), B, cdt)
+    # [G, 1] group actives vs [1, T] compacted leaves -> [G, T] mask;
+    # segment-padding rows carry leaf -1 and actives pad with -2, so
+    # padding never matches (its bins column is garbage by design)
+    m = ga_ref[:] == leaf_ref[:]
+    vw = _weighted_cols(m, vals_ref[:], n_cols, pad_cols, cdt)
+    out_ref[:] += jax.lax.dot_general(
+        oh, vw, (((1,), (1,)), ((), ())),
+        preferred_element_type=jnp.int32 if quant else jnp.float32)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("num_features", "max_bins", "num_leaf_slots", "mode",
+                     "row_tile", "interpret"))
+def hist_active_compact(bins_t: jnp.ndarray,
+                        vals: jnp.ndarray,
+                        row_leaf: jnp.ndarray,
+                        active: jnp.ndarray,
+                        scales: jnp.ndarray | None = None,
+                        *,
+                        num_features: int,
+                        max_bins: int,
+                        num_leaf_slots: int,
+                        mode: str = "hilo",
+                        row_tile: int = DEFAULT_ROW_TILE,
+                        interpret: bool = False) -> jnp.ndarray:
+    """Leaf-compacted histograms for the active leaves: same contract as
+    ``hist_active_pallas`` (``-> [A, F, B, 3]`` f32) with per-row MXU
+    work independent of ``A``.
+
+    ``row_leaf`` must be the full ``[n_pad]`` padded leaf vector
+    (padding rows ``-1``).  Unlike the wide kernel, ``-1`` padding
+    entries of ``active`` yield exact ZERO slots (their rows never
+    enter the compacted stream), matching the scatter oracle.
+    """
+    F_pad, n_pad = bins_t.shape
+    C = vals.shape[0]
+    A = active.shape[0]
+    B = bin_stride(max_bins)
+    G = COMPACT_GROUP
+    n_groups = -(-A // G)
+
+    Cc, Gp, cols = _col_layout(G, mode)
+    assert Cc == C and Gp == G, (Cc, C, Gp)
+    T = _pick_row_tile(n_pad, B, cols, C, row_tile)
+    assert n_pad % T == 0, (n_pad, T)
+    pad_cols = cols - C * Gp
+
+    src, tile_group, group_active = compact_plan(
+        row_leaf.astype(jnp.int32), active.astype(jnp.int32),
+        num_leaf_slots, T)
+    sc = jnp.maximum(src, 0)
+    # the regroup gather: one pass over the bins/value streams applies
+    # the leaf-contiguous permutation (the DataPartition::Split +
+    # ordered-gradients analog in one shot)
+    bins_c = jnp.take(bins_t, sc, axis=1)            # [F_pad, n_c]
+    vals_c = jnp.take(vals, sc, axis=1)              # [C, n_c]
+    leaf_c = jnp.where(src >= 0, row_leaf.astype(jnp.int32)[sc],
+                       -1)[None, :]                  # [1, n_c]
+
+    # feature tiling: identical VMEM model to the wide kernel, at the
+    # group column count
+    ft_cap = max(1, _feat_tile_cap(B, cols, T, C))
+    if ft_cap >= F_pad:
+        feat_tile = F_pad
+    else:
+        feat_tile = max(8, (ft_cap // 8) * 8)
+    F_grid = _round_up(F_pad, feat_tile)
+    if F_grid != F_pad:
+        bins_c = jnp.pad(bins_c, ((0, F_grid - F_pad), (0, 0)))
+    nft = F_grid // feat_tile
+    n_c = bins_c.shape[1]
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=(nft, n_c // T),
+        in_specs=[
+            pl.BlockSpec((G, 1), lambda j, i, tg: (0, tg[i]),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((feat_tile, T), lambda j, i, tg: (j, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((C, T), lambda j, i, tg: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec((1, T), lambda j, i, tg: (0, i),
+                         memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec((feat_tile * B, cols),
+                               lambda j, i, tg: (tg[i] * nft + j, 0),
+                               memory_space=pltpu.VMEM),
+    )
+    out = pl.pallas_call(
+        functools.partial(_hist_compact_kernel, n_cols=C, B=B,
+                          pad_cols=pad_cols),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct(
+            ((n_groups + 1) * F_grid * B, cols),
+            jnp.int32 if is_quantized(mode) else jnp.float32),
+        interpret=interpret,
+    )(tile_group, group_active, bins_c, vals_c, leaf_c)
+
+    # [(n_groups+1)*F_grid*B, cols] -> [A, F, B, 3] (trash block dropped)
+    out = out.reshape(n_groups + 1, F_grid, B, cols)[
+        :n_groups, :, :, :C * Gp]
+    out = out.reshape(n_groups, F_grid, B, C, Gp)
+    out = out.transpose(0, 4, 1, 2, 3).reshape(n_groups * Gp, F_grid, B, C)
+    out = out[:A, :num_features]
+    return combine_hist_cols(out, mode, scales)

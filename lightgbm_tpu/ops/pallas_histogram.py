@@ -508,8 +508,17 @@ def _unpack_hist(out, B, cols, C, A_pad, A, num_features, mode, scales):
     out = out.reshape(F_grid, B, cols)[:, :, :C * A_pad]
     out = out.reshape(F_grid, B, C, A_pad)
     out = out.transpose(3, 0, 1, 2)[:A, :num_features]       # [A, F, B, C]
+    return combine_hist_cols(out, mode, scales)
+
+
+def combine_hist_cols(out, mode, scales):
+    """``[..., C]`` raw kernel value columns -> ``[..., 3]`` f32
+    ``(sum_grad, sum_hess, count)``: combine hi/lo pairs or dequantize.
+    Shared by the wide kernel's unpack and the leaf-compacted kernel
+    (``ops/compact.py``), so the two paths cannot drift."""
     if is_quantized(mode):
         return dequant_hist(out, scales, mode)
+    C = out.shape[-1]
     if C == 5:
         g = out[..., 0] + out[..., 1]
         h = out[..., 2] + out[..., 3]
@@ -558,10 +567,18 @@ def hist_active_scatter(bins: jnp.ndarray,
 
 
 def default_backend() -> str:
+    """"compact" (the wide MXU kernel + leaf-compacted deep waves,
+    ``ops/compact.py``) on TPU, "scatter" elsewhere.  The compact
+    backend degrades to plain "pallas" per-config via
+    ``learner.serial.resolve_backend`` (small trees never reach the
+    slot threshold; VMEM-infeasible groups fall back), so forcing
+    ``LGBM_TPU_NO_COMPACT=1`` only matters for A/B on deep trees."""
     forced = os.environ.get("LGBM_TPU_HIST_BACKEND", "")
     if forced:
         return forced
-    return "pallas" if jax.default_backend() == "tpu" else "scatter"
+    if jax.default_backend() != "tpu":
+        return "scatter"
+    return "pallas" if os.environ.get("LGBM_TPU_NO_COMPACT") else "compact"
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,19 @@ collectives on ICI/DCN:
 
 All three return bit-identical trees on every shard (the reference's
 distributed-determinism requirement, `application.cpp:249-254`).
+
+Deep-wave compaction threads through all three learners via the shared
+``make_hist_fn`` seam: on the "compact" backend (the TPU default for
+deep trees) each shard regroups ITS OWN rows leaf-contiguously and runs
+the grouped kernel (`ops/compact.py`) for waves above the slot
+threshold.  The collective schedule is untouched — the data-parallel
+``psum`` still reduces the same ``[A, F, B, 3]`` active-leaf block (the
+compacted kernel has the identical output contract), feature-parallel
+shards compact their own column slice, and voting-parallel compacts its
+local histograms before the vote — so spmdcheck's static schedule and
+the runtime flight-recorder fingerprints are identical to the wide
+kernel's (shape/dtype/op/axis all unchanged; `tests/test_compact.py::
+test_compact_psum_data_parallel` pins the psum'd parity).
 """
 from __future__ import annotations
 

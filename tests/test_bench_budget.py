@@ -89,6 +89,74 @@ def test_deadline_skips_aux_legs_with_markers(bench_run):
     assert final["deadline_s"] > 0 and final["elapsed_s"] >= 0
 
 
+def test_dryrun_emits_wave_table_and_north_star_parses():
+    """`bench.py --dryrun` must emit the per-active-slot-bucket wave
+    table (the deep-wave ns/row regression tracker) and confirm the
+    committed north_star.json wave_kernel entries parse — the
+    mechanics gate for the BENCH_r* wave recording."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--dryrun"],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    lines = _parse_lines(proc.stdout)
+    assert lines, proc.stdout
+    out = lines[-1]
+    assert out["metric"] == "wave_kernel_ns_per_row" and out["dryrun"]
+    buckets = {r["active"] for r in out["wave_kernel"]}
+    assert buckets >= {8, 32, 64, 128}
+    for r in out["wave_kernel"]:
+        assert r["wide_ns_per_row"] > 0
+        if r["active"] > 32:        # deep buckets carry the compact leg
+            assert r["compact_ns_per_row"] > 0
+    assert out["north_star_parse_ok"] is True
+    assert set(out["north_star_wave_buckets"]) >= {32, 64, 128}
+
+
+def test_north_star_wave_entries_parse():
+    """The committed artifact itself: every wave_kernel entry carries a
+    positive active-slot bucket and ns/row (what the bench table and
+    ISSUE arithmetic consume)."""
+    path = os.path.join(REPO, "tests", "data", "north_star.json")
+    with open(path) as fh:
+        ns = json.load(fh)
+    wk = ns["wave_kernel"]
+    assert len(wk) >= 3
+    for row in wk:
+        assert int(row["active"]) > 0
+        assert float(row["ns_per_row"]) > 0
+        assert float(row["mxu_util_vs_measured_peak"]) > 0
+
+
+def test_gate_bearing_hard_failure_zeroes_headline():
+    """ADVICE r5 #2: a gate-bearing leg (here: valid) that crashes BOTH
+    attempts with the same deterministic error must zero vs_baseline —
+    legs_hard_failed alone must not leave the headline green."""
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", ""),
+           "BENCH_ROWS": "2000", "BENCH_ITERS": "2",
+           "BENCH_LEAVES": "7", "BENCH_BIN": "15",
+           "BENCH_FULL": "0", "BENCH_255": "0", "BENCH_RANK": "0",
+           "BENCH_WAVES": "0",
+           "BENCH_FORCE_FAIL": "valid"}
+    env.pop("XLA_FLAGS", None)
+    env.pop("BENCH_DATA", None)
+    env.pop("BENCH_DEADLINE_S", None)
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    final = _parse_lines(proc.stdout)[-1]
+    assert final.get("legs_hard_failed") == ["valid"], final
+    assert "forced failure" in final.get("valid_leg", ""), final
+    assert final["vs_baseline"] == 0.0, final
+    assert final["value"] > 0          # the headline NUMBER is retained
+
+
 def test_auc_gate_tightened_beyond_085(bench_run):
     """VERDICT r5 Weak #7: the synthetic AUC floor must sit at the
     recorded-r4-calibrated default (0.93), not the old 0.85 — and be

@@ -16,14 +16,19 @@ from lightgbm_tpu.ops.pallas_histogram import (
     pack_values_q, transpose_bins)
 
 
-@pytest.mark.parametrize("max_bins,F,mode", [
-    (63, 28, "hilo"),
-    (63, 28, "bf16"),
-    (255, 10, "hilo"),     # forces feature tiling (acc VMEM budget)
+@pytest.mark.parametrize("max_bins,F,mode,kernel", [
+    (63, 28, "hilo", "wide"),
+    (63, 28, "bf16", "wide"),
+    (255, 10, "hilo", "wide"),  # forces feature tiling (acc VMEM budget)
+    # the leaf-compacted deep-wave kernel shares this oracle matrix
+    # (ops/compact.py; deep-slot shapes in tests/test_compact.py)
+    (63, 28, "hilo", "compact"),
+    (255, 10, "hhilo", "compact"),
 ])
-def test_kernel_matches_scatter(max_bins, F, mode):
+def test_kernel_matches_scatter(max_bins, F, mode, kernel):
     rng = np.random.RandomState(7)
-    n, L, A = 3000, 31, 15
+    n, L = 3000, 31
+    A = 15 if kernel == "wide" else 64   # compact needs A > threshold-ish
     bins = rng.randint(0, max_bins, size=(n, F)).astype(np.uint8)
     grad = rng.normal(size=n).astype(np.float32)
     hess = rng.uniform(0.1, 1.0, size=n).astype(np.float32)
@@ -33,11 +38,20 @@ def test_kernel_matches_scatter(max_bins, F, mode):
     active[:10] = rng.choice(L, 10, replace=False)
 
     bins_j = jnp.asarray(bins)
-    out_p = hist_active_pallas(
-        transpose_bins(bins_j), pack_values(jnp.asarray(grad),
-                                            jnp.asarray(hess), mode),
-        jnp.asarray(row_leaf), jnp.asarray(active),
-        num_features=F, max_bins=max_bins, mode=mode, interpret=True)
+    bt = transpose_bins(bins_j)
+    vals = pack_values(jnp.asarray(grad), jnp.asarray(hess), mode)
+    if kernel == "wide":
+        out_p = hist_active_pallas(
+            bt, vals, jnp.asarray(row_leaf), jnp.asarray(active),
+            num_features=F, max_bins=max_bins, mode=mode, interpret=True)
+    else:
+        from lightgbm_tpu.ops.compact import hist_active_compact
+        leaf_p = jnp.pad(jnp.asarray(row_leaf), (0, bt.shape[1] - n),
+                         constant_values=-1)
+        out_p = hist_active_compact(
+            bt, vals, leaf_p, jnp.asarray(active),
+            num_features=F, max_bins=max_bins, num_leaf_slots=L,
+            mode=mode, interpret=True)
     out_s = hist_active_scatter(
         bins_j, jnp.asarray(grad), jnp.asarray(hess),
         jnp.asarray(row_leaf), jnp.asarray(active),
@@ -47,6 +61,8 @@ def test_kernel_matches_scatter(max_bins, F, mode):
     assert p.shape == s.shape == (10, F, bin_stride(max_bins), 3)
     # counts are exact in any mode (0/1 one-hot, f32 accumulate)
     np.testing.assert_array_equal(p[..., 2], s[..., 2])
+    # hilo carries BOTH value columns as hi/lo pairs (~f32); bf16 and
+    # hhilo (plain-bf16 gradient column) are bf16-grade on grad sums
     tol = 5e-4 if mode == "hilo" else 2e-2
     scale = np.abs(s[..., :2]).max() + 1e-9
     np.testing.assert_allclose(p[..., :2] / scale, s[..., :2] / scale,
