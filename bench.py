@@ -369,6 +369,126 @@ def wave_microbench(dryrun: bool = False):
     return table
 
 
+# keys every serve (predict) leg must emit — `--dryrun` validates this
+# schema at toy shape as the tier-1 mechanics gate (tests/test_bench_budget)
+SERVE_SCHEMA_KEYS = (
+    "serve_rows", "serve_trees", "serve_rows_per_sec",
+    "serve_binned_rows_per_sec", "serve_host_rows_per_sec",
+    "serve_vs_host", "serve_compile_s", "serve_parity_ok",
+    "serve_latency_ms", "serve_steady_recompiles", "serve_recompile_ok",
+    "serve_requests", "serve_batches")
+
+
+def serve_leg(dryrun: bool = False):
+    """TPU-resident prediction serving (ROADMAP item 3): big-batch
+    rows/s through the compiled predictor (`lightgbm_tpu/serve/`), the
+    int8-binned fast path, p50/p99 request latency per padding bucket
+    through the async micro-batching harness, and a zero-post-warmup-
+    recompile check over mixed batch sizes.
+
+    Comparison anchor: the HOST vectorized numpy traversal of the same
+    model (`Tree.predict_batch` — the in-repo analog of the reference's
+    per-row `src/application/predictor.hpp` walk, which is strictly
+    slower still; the reference publishes no predictor throughput
+    figure to quote).  Gates: device scores must match the f64 host
+    oracle within 1 ulp f32 (`serve_parity_ok`) and steady-state
+    serving must never re-enter XLA (`serve_recompile_ok`)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import PredictionServer, compile_model
+    from lightgbm_tpu.obs.trace_contract import CompileTracker
+
+    f = 5 if dryrun else 28
+    n_train = int(os.environ.get("BENCH_SERVE_TRAIN_ROWS",
+                                 2_000 if dryrun else 200_000))
+    iters = int(os.environ.get("BENCH_SERVE_ITERS", 4 if dryrun else 100))
+    leaves = 7 if dryrun else 63
+    n_big = int(os.environ.get("BENCH_SERVE_ROWS",
+                               2_048 if dryrun else 1 << 20))
+    reps = 1 if dryrun else 4
+    rng = np.random.RandomState(11)
+    X = rng.normal(size=(n_train, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] - X[:, 2]
+         + rng.normal(scale=1.0, size=n_train) > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.train(params, ds, num_boost_round=iters, verbose_eval=False)
+    del X, ds
+
+    t0 = time.time()
+    cm = compile_model(bst)
+    compile_s = time.time() - t0
+    Xq = rng.normal(size=(n_big, f)).astype(np.float32)
+
+    def timed_rows(fn):
+        fn()                                    # warm: compile + steady
+        t0 = time.time()
+        for _ in range(reps):
+            out = fn()
+        _sync_np(out)
+        return n_big * reps / (time.time() - t0)
+
+    def _sync_np(x):
+        return np.asarray(x).ravel()[:1]
+
+    # one-dispatch big-batch scoring (n_big is itself a bucket size)
+    dev_rate = timed_rows(lambda: cm.predict_raw(Xq))
+    bins = cm.bin_rows(Xq)
+    binned_rate = timed_rows(lambda: cm.predict_raw(bins, binned=True))
+
+    # host anchor: vectorized numpy traversal of the same trees
+    n_host = min(n_big, 512 if dryrun else 20_000)
+    Xh = Xq[:n_host].astype(np.float64)
+    t0 = time.time()
+    host = np.zeros(n_host)
+    for t in bst._gbdt.models:
+        host += t.predict_batch(Xh)
+    host_s = time.time() - t0
+    host_rate = n_host / max(host_s, 1e-9)
+
+    # parity gate: device raw scores within 1 ulp f32 of the f64 oracle
+    dev_sample = np.asarray(cm.predict_raw(Xq[:n_host]), np.float64)
+    ulp = np.spacing(np.abs(host).astype(np.float32)).astype(np.float64)
+    parity_ok = bool(np.all(np.abs(dev_sample - host) <= ulp))
+
+    # async harness over mixed batch sizes, under a compile tracker:
+    # warmup compiles the bucket set, then steady traffic must never
+    # re-enter XLA (the padding buckets working as designed)
+    buckets = (64, 256, 1024) if dryrun else (256, 1024, 4096)
+    sizes = [1, 3, 17, 100, 240, 900]
+    n_req = int(os.environ.get("BENCH_SERVE_REQUESTS",
+                               30 if dryrun else 300))
+    with CompileTracker(track_threads=False) as tracker:
+        srv = PredictionServer(cm, max_batch=max(buckets),
+                               max_wait_ms=1.0, buckets=buckets,
+                               min_bucket=buckets[0], raw_score=True)
+        tracker.mark_steady()
+        futs = [srv.submit(Xq[(37 * i) % (n_big - 1024):][:sizes[i % len(sizes)]])
+                for i in range(n_req)]
+        for fu in futs:
+            fu.result(120)
+        stats = srv.stats()
+        srv.close()
+    rep = tracker.report()
+    return {
+        "serve_rows": n_big, "serve_trees": cm.num_trees,
+        "serve_rows_per_sec": round(dev_rate, 1),
+        "serve_binned_rows_per_sec": round(binned_rate, 1),
+        "serve_host_rows_per_sec": round(host_rate, 1),
+        "serve_vs_host": round(dev_rate / max(host_rate, 1e-9), 4),
+        "serve_compile_s": round(compile_s, 3),
+        "serve_parity_ok": parity_ok,
+        "serve_latency_ms": stats["latency_ms"],
+        "serve_steady_recompiles": rep["compiles_steady"],
+        "serve_recompile_ok": bool(rep["steady_ok"]),
+        "serve_requests": stats["resolved"],
+        "serve_batches": stats["batches"],
+        "serve_baseline": "host vectorized numpy traversal of the same "
+                          "model (reference predictor.hpp per-row walk "
+                          "analog; no published reference figure)",
+    }
+
+
 def dryrun_main():
     """``bench.py --dryrun``: emit the per-bucket wave table at toy
     shape (CPU-safe, seconds) and cross-check that the committed
@@ -391,6 +511,23 @@ def dryrun_main():
             "north_star_parse_ok": ns_ok}
     if err:
         line["north_star_parse_error"] = err
+    # serve (predict) leg schema gate: run the REAL leg at toy shape on
+    # CPU and check every field the TPU run will record is present and
+    # sane — the tier-1 mechanics gate for the predict-leg artifact
+    try:
+        sleg = serve_leg(dryrun=True)
+        missing = [k for k in SERVE_SCHEMA_KEYS if k not in sleg]
+        sane = (not missing and sleg["serve_rows_per_sec"] > 0
+                and sleg["serve_host_rows_per_sec"] > 0
+                and sleg["serve_parity_ok"] and sleg["serve_recompile_ok"]
+                and isinstance(sleg["serve_latency_ms"], dict))
+        line.update(sleg)
+        line["serve_schema_ok"] = bool(sane)
+        if missing:
+            line["serve_schema_missing"] = missing
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["serve_schema_ok"] = False
+        line["serve_leg"] = f"failed: {type(exc).__name__}: {exc}"
     _emit(line)
 
 
@@ -618,6 +755,20 @@ def main():
         line["vs_baseline"] = round(vs if auc_ok else 0.0, 4)
         line["partial"] = "headline-full"
         _emit(line)
+
+    # serve (predict) leg: the inference workload (ROADMAP item 3) —
+    # big-batch rows/s, the int8-binned fast path, per-bucket p50/p99
+    # through the async harness, and the zero-recompile check.  Its
+    # gates (1-ulp parity vs the host oracle, zero post-warmup
+    # recompiles) zero the headline when the leg RAN and failed them.
+    if os.environ.get("BENCH_SERVE", "1") != "0":
+        sleg = _leg(line, "serve", serve_leg, gate=True)
+        if sleg is not None:
+            line.update(sleg)
+            if not (sleg["serve_parity_ok"] and sleg["serve_recompile_ok"]):
+                auc_ok = False
+            line["partial"] = "headline-full+serve"
+            _emit(line)
 
     # with-valid leg (VERDICT r4 #1): the standard train+valid+early-stop
     # workflow must stay on the fused block path, within ~20% of the

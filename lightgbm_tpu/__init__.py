@@ -14,12 +14,12 @@ from . import obs
 from .basic import Booster, Dataset
 from .callback import (EarlyStopException, early_stopping, print_evaluation,
                        record_evaluation, reset_parameter, telemetry)
-from .engine import cv, train
+from .engine import cv, predict, train
 
 __version__ = "0.1.0"
 
 __all__ = [
-    "Dataset", "Booster", "train", "cv", "obs",
+    "Dataset", "Booster", "train", "cv", "predict", "obs", "serve",
     "early_stopping", "print_evaluation", "record_evaluation",
     "reset_parameter", "EarlyStopException", "telemetry",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
@@ -35,4 +35,7 @@ def __getattr__(name):
     if name in ("plot_importance", "plot_metric", "plot_tree"):
         from . import plotting as _pl
         return getattr(_pl, name)
+    if name == "serve":
+        from . import serve as _serve
+        return _serve
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -311,22 +311,57 @@ class Booster:
 
     # -- prediction -----------------------------------------------------
     def predict(self, data, num_iteration=-1, raw_score=False,
-                pred_leaf=False, pred_contrib=False, **kwargs):
+                pred_leaf=False, pred_contrib=False, device=None,
+                **kwargs):
+        """Predict (reference Booster.predict surface).
+
+        ``num_iteration`` (``<= 0`` -> ``best_iteration`` when set)
+        truncates EVERY mode identically — the slicing lives in one
+        place per path (``GBDT.predict_raw`` / ``GBDT.predict_leaf`` /
+        ``serve.compile_model``), multiclass included.
+
+        ``device`` selects the serving path: ``True`` compiles the
+        model once (cached per truncation) into the TPU-resident
+        tensorized predictor (``lightgbm_tpu/serve/``) and scores the
+        whole batch in one jitted dispatch; ``False`` forces the
+        legacy path; ``None`` (default) follows the
+        ``LGBM_TPU_PREDICT_DEVICE`` env var (off by default).
+        ``pred_contrib`` always takes the host path.
+        """
         X, _ = _data_to_numpy(data)
         if num_iteration is None or num_iteration <= 0:
             num_iteration = (self.best_iteration
                              if self.best_iteration > 0 else -1)
-        if pred_leaf:
-            leaves = self._gbdt.predict_leaf(X)
-            if num_iteration and num_iteration > 0:
-                T = num_iteration * max(1, self._gbdt.num_tree_per_iteration)
-                leaves = leaves[:, :T]
-            return leaves
+        if device is None:
+            import os
+            device = os.environ.get("LGBM_TPU_PREDICT_DEVICE",
+                                    "") not in ("", "0")
         if pred_contrib:
             from .boosting.contrib import predict_contrib
             return predict_contrib(self._gbdt, X, num_iteration)
+        if device:
+            cm = self._device_predictor(num_iteration)
+            if pred_leaf:
+                return cm.leaf_indices(X)
+            return cm.predict(X, raw_score=raw_score)
+        if pred_leaf:
+            return self._gbdt.predict_leaf(X, num_iteration=num_iteration)
         return self._gbdt.predict(X, raw_score=raw_score,
                                   num_iteration=num_iteration)
+
+    def _device_predictor(self, num_iteration=-1):
+        """The serving-compiled form of this model, cached per
+        (model length, truncation) — training another iteration or
+        rolling back invalidates by key."""
+        from .serve import compile_model
+        key = (len(self._gbdt.models), int(num_iteration or -1))
+        cache = getattr(self, "_serve_cache", None)
+        if cache is None or key not in cache:
+            # single-entry cache: stale packs from previous lengths
+            # would otherwise pin device memory
+            self._serve_cache = {key: compile_model(
+                self._gbdt, num_iteration=num_iteration)}
+        return self._serve_cache[key]
 
     def refit(self, data, label, decay_rate: float = 0.9,
               **kwargs) -> "Booster":

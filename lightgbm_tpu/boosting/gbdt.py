@@ -1700,20 +1700,31 @@ class GBDT:
             raw = raw / T
         return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
 
-    def predict_leaf(self, X: np.ndarray) -> np.ndarray:
-        """Per-tree leaf indices (PredictLeafIndex)."""
+    def predict_leaf(self, X: np.ndarray,
+                     num_iteration: int = -1) -> np.ndarray:
+        """Per-tree leaf indices (PredictLeafIndex).
+
+        ``num_iteration`` truncation lives HERE — the same seam
+        ``predict_raw`` uses — so every surface (``Booster.predict``,
+        sklearn, C API, serve) slices identically, multiclass included
+        (``num_iteration * num_tree_per_iteration`` trees), and the
+        truncated trees are never stacked or walked at all."""
         from ..models.tree import predict_leaf_binned
+        models = self.models
+        if num_iteration is not None and num_iteration > 0:
+            K = max(1, self.num_tree_per_iteration)
+            models = models[:num_iteration * K]
         valid = (self.train_set.create_valid(np.asarray(X),
                                              prediction_mode=True)
                  if self.train_set is not None else None)
         if valid is None:
             Xf = np.asarray(X, np.float64)
-            out = np.zeros((len(X), len(self.models)), np.int32)
-            for i, t in enumerate(self.models):
+            out = np.zeros((len(X), len(models)), np.int32)
+            for i, t in enumerate(models):
                 out[:, i] = t.predict_leaf_batch(Xf)
             return out
         dd = to_device(valid)
-        st = stack_trees(self.models, max_bins=dd.max_bins + 2)
+        st = stack_trees(models, max_bins=dd.max_bins + 2)
         return np.asarray(predict_leaf_binned(
             st, dd.bins, dd.nan_bins, dd.default_bins, dd.missing_types,
             **self._bundle_kw(dd)))

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import ctypes
 import json
+import os
 from typing import Dict, List, Optional
 
 import numpy as np
@@ -497,9 +498,20 @@ def booster_calc_num_predict(h: int, nrow: int, predict_type: int,
     return int(nrow * max(1, g.num_class))
 
 
+def _capi_device_flag():
+    """Whether the C surface routes through the TPU-resident serving
+    predictor (``lightgbm_tpu/serve/``).  The shim drops the reference
+    ``parameter`` string, so the knob is the ``LGBM_TPU_CAPI_DEVICE``
+    env var: unset/``0`` keeps the legacy path (``None`` defers to
+    ``Booster.predict``'s own default resolution)."""
+    v = os.environ.get("LGBM_TPU_CAPI_DEVICE", "")
+    return True if v not in ("", "0") else None
+
+
 def _predict_to_buffer(b, X: np.ndarray, predict_type: int,
                        num_iteration: int, out_ptr: int) -> int:
     pred = b.predict(X, num_iteration=num_iteration,
+                     device=_capi_device_flag(),
                      **_predict_kwargs(predict_type))
     pred = np.ascontiguousarray(pred, np.float64).reshape(-1)
     ctypes.memmove(int(out_ptr), pred.ctypes.data, pred.nbytes)
@@ -547,6 +559,7 @@ def booster_predict_for_file(h: int, data_filename: str, has_header: int,
     X, _ = load_raw_matrix(data_filename, has_header=bool(has_header))
     b = _get(h)
     pred = b.predict(X, num_iteration=num_iteration,
+                     device=_capi_device_flag(),
                      **_predict_kwargs(predict_type))
     pred = np.asarray(pred)
     if pred.ndim == 1:

@@ -240,6 +240,32 @@ def _continue_training(booster: Booster, init_model_str: str) -> None:
         g.scores = g.scores.at[:, k].add(pred)
 
 
+def predict(model, data, num_iteration: int = -1, raw_score: bool = False,
+            pred_leaf: bool = False, pred_contrib: bool = False,
+            device=None, **kwargs):
+    """Module-level prediction entry point (ROADMAP item 3 surface).
+
+    ``model`` is a :class:`Booster`, a model-file path, or a model
+    string in the reference text format — the latter two are loaded on
+    the spot, so a serving process can go file -> scores in one call.
+    ``device=True`` routes through the TPU-resident tensorized
+    predictor (``lightgbm_tpu/serve/``); see ``Booster.predict``.
+    """
+    if isinstance(model, Booster):
+        bst = model
+    elif isinstance(model, str):
+        if "Tree=" in model or "\n" in model:
+            bst = Booster(model_str=model)
+        else:
+            bst = Booster(model_file=model)
+    else:
+        raise TypeError(f"model must be a Booster, model file path, or "
+                        f"model string, got {type(model).__name__}")
+    return bst.predict(data, num_iteration=num_iteration,
+                       raw_score=raw_score, pred_leaf=pred_leaf,
+                       pred_contrib=pred_contrib, device=device, **kwargs)
+
+
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
        metrics=None, fobj=None, feval=None, init_model=None,

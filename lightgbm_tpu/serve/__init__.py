@@ -1,0 +1,25 @@
+"""TPU-resident tensorized prediction serving.
+
+* :mod:`~lightgbm_tpu.serve.compiler` — pack a trained booster
+  (including reference-format text models) into device-resident stacked
+  tensors and score whole batches in one jitted dispatch, with an
+  int8 binned fast path riding the training bin pipeline;
+* :mod:`~lightgbm_tpu.serve.server` — micro-batching async harness
+  (request queue, padding buckets, telemetry, retries, graceful drain).
+
+Entry points::
+
+    from lightgbm_tpu.serve import compile_model, PredictionServer
+    cm = compile_model(booster)            # or Booster.predict(device=True)
+    scores = cm.predict(X)                 # one dispatch, bucket-padded
+    with PredictionServer(cm) as srv:
+        fut = srv.submit(row)              # micro-batched async
+"""
+from .compiler import (CompiledModel, ServePack, build_pack, compile_model,
+                       compile_trees, next_bucket)
+from .server import PredictionServer
+
+__all__ = [
+    "CompiledModel", "ServePack", "build_pack", "compile_model",
+    "compile_trees", "next_bucket", "PredictionServer",
+]

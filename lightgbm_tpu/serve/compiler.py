@@ -1,0 +1,568 @@
+"""Model compiler: a trained booster packed for TPU-resident serving.
+
+The reference serves predictions through a per-row pointer chase
+(`src/application/predictor.hpp`, ``gbdt_prediction.cpp``: one thread
+walks one tree for one row at a time).  ``Booster.predict`` here used to
+bottom out in the same place — a host-side numpy traversal
+(``models/tree.py predict_leaf_batch``) that never touches the device.
+This module is the serving counterpart of the training redesign: the
+whole forest becomes a handful of device-resident ``[T, M]`` node
+tensors plus flattened categorical bitset tables, and ONE jitted
+program routes a whole ``[batch, F]`` block through every tree with
+per-depth gathers + ``where`` selects (the walk loop is padded to the
+forest's max depth, a static program parameter).
+
+Exactness contract (tested by ``tests/test_serve.py``):
+
+* **Leaf routing is bit-exact** against the numpy oracle
+  (``Tree.predict_leaf_batch`` / ``predict_row``) for float32 inputs.
+  The device compares in f32 against thresholds pre-rounded TOWARD
+  -inf to f32 (:func:`_f32_floor`): for any f32 ``x`` and f64 threshold
+  ``t``, ``x <= t  <=>  x <= floor_f32(t)`` — so the f32 compare
+  reproduces the reference's f64 ``NumericalDecision`` exactly.
+  float64 inputs are cast to f32 first (documented narrowing).
+* **Scores are within 1 ulp (f32)** of the f64 sequential
+  tree-accumulation oracle: per-leaf f64 values are carried as hi/lo
+  f32 pairs and accumulated with Neumaier compensated summation in
+  tree order, so the device sum tracks the exact sum to ~2^-45
+  relative before the single final rounding.
+
+Two input paths share the walk:
+
+* **raw** — ``[n, F]`` float rows, original feature indices,
+  categorical membership via flattened value bitsets (the model file's
+  ``cat_threshold`` words, one device table for the whole forest);
+* **binned fast path** — ``[n, Fi]`` int8/int32 rows pre-binned
+  through the TRAINING bin pipeline (``io/binning.py`` mappers): node
+  compares become integer ``bin <= threshold_bin`` and categorical
+  membership uses bin-space bitsets, skipping all float work.
+"""
+from __future__ import annotations
+
+import functools
+import os
+from typing import Any, List, NamedTuple, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..io.binning import MISSING_NAN, MISSING_ZERO
+from ..models.tree import (K_CATEGORICAL_MASK, K_DEFAULT_LEFT_MASK,
+                           _K_ZERO_THRESHOLD, Tree)
+from ..obs import counter_add, span
+from ..utils.log import log_info
+
+
+def _f32_floor(values: np.ndarray) -> np.ndarray:
+    """Largest float32 <= each (float64) value.
+
+    For f32 ``x`` and f64 ``t``: ``x <= t`` iff ``x <= _f32_floor(t)``,
+    which is what makes the device's f32 threshold compare bit-exact
+    against the reference's f64 decision.  +-inf and NaN pass through.
+    """
+    v = np.asarray(values, np.float64)
+    v32 = v.astype(np.float32)
+    over = v32.astype(np.float64) > v
+    return np.where(over, np.nextafter(v32, np.float32(-np.inf)), v32)
+
+
+# floor-rounded f32 image of the reference kZeroThreshold: |x| <= 1e-35
+# in f64 iff |x| <= this in f32, for f32 x
+_ZERO_EPS_F32 = float(
+    _f32_floor(np.array([_K_ZERO_THRESHOLD], np.float64))[0])
+
+
+@jax.tree_util.register_pytree_node_class
+class ServePack(NamedTuple):
+    """The forest as device-resident stacked arrays (pytree).
+
+    Node axes are ``[T, M]`` (M = max leaves - 1); ``max_depth`` is
+    static aux data bounding the jitted walk loop.  Binned-path fields
+    are 1-element placeholders when the pack was built without mappers.
+    """
+
+    split_feature: jnp.ndarray        # [T, M] int32, ORIGINAL feature idx
+    threshold: jnp.ndarray            # [T, M] f32, floor-rounded
+    default_left: jnp.ndarray         # [T, M] bool
+    is_cat: jnp.ndarray               # [T, M] bool
+    miss_zero: jnp.ndarray            # [T, M] bool (missing_type == Zero)
+    miss_nan: jnp.ndarray             # [T, M] bool (missing_type == NaN)
+    left_child: jnp.ndarray           # [T, M] int32 (>=0 node, ~leaf)
+    right_child: jnp.ndarray          # [T, M] int32
+    leaf_hi: jnp.ndarray              # [T, L] f32 = f32(leaf_value)
+    leaf_lo: jnp.ndarray              # [T, L] f32 = f32(value - f64(hi))
+    cat_offset: jnp.ndarray           # [T, M] int32 into cat_words
+    cat_nwords: jnp.ndarray           # [T, M] int32
+    cat_words: jnp.ndarray            # [W] uint32 flattened value bitsets
+    split_feature_inner: jnp.ndarray  # [T, M] int32, used-column idx
+    threshold_bin: jnp.ndarray        # [T, M] int32
+    catbin_offset: jnp.ndarray        # [T, M] int32 into catbin_words
+    catbin_nwords: jnp.ndarray        # [T, M] int32
+    catbin_words: jnp.ndarray         # [Wb] uint32 bin-space bitsets
+    feat_missing_type: jnp.ndarray    # [Fi] int32 (binned path)
+    feat_nan_bin: jnp.ndarray         # [Fi] int32
+    feat_zero_bin: jnp.ndarray        # [Fi] int32
+    max_depth: int                    # static: walk loop bound
+
+    def tree_flatten(self):
+        return (tuple(self[:-1]), self.max_depth)
+
+    @classmethod
+    def tree_unflatten(cls, aux, children):
+        return cls(*children, aux)
+
+    @property
+    def num_trees(self) -> int:
+        return self.split_feature.shape[0]
+
+
+def build_pack(trees: Sequence[Tree], mappers=None,
+               used_features: Optional[Sequence[int]] = None) -> ServePack:
+    """Pack host trees into a :class:`ServePack`.
+
+    ``mappers`` (per ORIGINAL feature, with ``used_features`` giving the
+    inner-column order) additionally builds the binned fast path; trees
+    must already be bin-aligned (trained in-process, or
+    ``align_with_mappers`` called after a text load).
+    """
+    T = len(trees)
+    L = max(max((t.num_leaves for t in trees), default=2), 2)
+    M = L - 1
+    sf = np.zeros((T, M), np.int32)
+    thr = np.zeros((T, M), np.float32)
+    dl = np.zeros((T, M), bool)
+    ic = np.zeros((T, M), bool)
+    mz = np.zeros((T, M), bool)
+    mn = np.zeros((T, M), bool)
+    lc = np.zeros((T, M), np.int32)
+    rc = np.zeros((T, M), np.int32)
+    hi = np.zeros((T, L), np.float32)
+    lo = np.zeros((T, L), np.float32)
+    co = np.zeros((T, M), np.int32)
+    cn = np.zeros((T, M), np.int32)
+    cat_words: List[int] = []
+    sfi = np.zeros((T, M), np.int32)
+    tb = np.zeros((T, M), np.int32)
+    bo = np.zeros((T, M), np.int32)
+    bn = np.zeros((T, M), np.int32)
+    catbin_words: List[int] = []
+    binned = mappers is not None
+    for i, t in enumerate(trees):
+        n = t.num_leaves
+        m = n - 1
+        v64 = np.asarray(t.leaf_value[:max(n, 1)], np.float64)
+        h = v64.astype(np.float32)
+        hi[i, :len(h)] = h
+        lo[i, :len(h)] = (v64 - h.astype(np.float64)).astype(np.float32)
+        if m == 0:
+            # num_leaves == 1 stump: both children land on leaf 0
+            lc[i, 0] = rc[i, 0] = ~0
+            continue
+        dt = np.asarray(t.decision_type[:m], np.int64)
+        sf[i, :m] = t.split_feature[:m]
+        sfi[i, :m] = t.split_feature_inner[:m]
+        thr[i, :m] = _f32_floor(t.threshold[:m])
+        dl[i, :m] = (dt & K_DEFAULT_LEFT_MASK) != 0
+        ic[i, :m] = (dt & K_CATEGORICAL_MASK) != 0
+        mt = (dt >> 2) & 3
+        mz[i, :m] = mt == MISSING_ZERO
+        mn[i, :m] = mt == MISSING_NAN
+        lc[i, :m] = t.left_child[:m]
+        rc[i, :m] = t.right_child[:m]
+        tb[i, :m] = t.threshold_bin[:m]
+        for node in range(m):
+            if not ic[i, node]:
+                continue
+            ci = int(t.threshold_bin[node])
+            words = [int(w) for w in
+                     t.cat_threshold[t.cat_boundaries[ci]:
+                                     t.cat_boundaries[ci + 1]]]
+            co[i, node] = len(cat_words)
+            cn[i, node] = len(words)
+            cat_words.extend(words)
+            if binned and ci < len(t.cat_left_bins):
+                bins = np.asarray(t.cat_left_bins[ci], np.int64)
+                nwords = int(bins.max()) // 32 + 1 if len(bins) else 1
+                bwords = [0] * nwords
+                for b in bins:
+                    bwords[int(b) // 32] |= 1 << (int(b) % 32)
+                bo[i, node] = len(catbin_words)
+                bn[i, node] = nwords
+                catbin_words.extend(bwords)
+    if binned:
+        inner = list(used_features if used_features is not None
+                     else range(len(mappers)))
+        fi_mt = np.zeros(max(len(inner), 1), np.int32)
+        fi_nan = np.full(max(len(inner), 1), -1, np.int32)
+        fi_zero = np.zeros(max(len(inner), 1), np.int32)
+        for j, f in enumerate(inner):
+            mp = mappers[f]
+            fi_mt[j] = mp.missing_type
+            fi_nan[j] = mp.num_bin - 1 if mp.missing_type == MISSING_NAN else -1
+            fi_zero[j] = mp.default_bin
+    else:
+        fi_mt = np.zeros(1, np.int32)
+        fi_nan = np.full(1, -1, np.int32)
+        fi_zero = np.zeros(1, np.int32)
+    depth = max(max((t.max_depth for t in trees), default=1), 1)
+    # power-of-two walk bound: the loop length is a static program
+    # parameter, so raw depths would recompile per forest shape
+    depth = 1 << (depth - 1).bit_length()
+    return ServePack(
+        jnp.asarray(sf), jnp.asarray(thr), jnp.asarray(dl), jnp.asarray(ic),
+        jnp.asarray(mz), jnp.asarray(mn), jnp.asarray(lc), jnp.asarray(rc),
+        jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(co), jnp.asarray(cn),
+        jnp.asarray(np.asarray(cat_words or [0], np.uint32)),
+        jnp.asarray(sfi), jnp.asarray(tb), jnp.asarray(bo), jnp.asarray(bn),
+        jnp.asarray(np.asarray(catbin_words or [0], np.uint32)),
+        jnp.asarray(fi_mt), jnp.asarray(fi_nan), jnp.asarray(fi_zero),
+        depth)
+
+
+# ---------------------------------------------------------------------------
+# jitted scorers
+# ---------------------------------------------------------------------------
+def _bitset_member(words, offset, nwords, v):
+    """``v in bitset`` per row — flattened-table lookup, no host work.
+    ``v < 0`` or beyond the node's words is a miss (reference
+    ``Common::FindInBitset``)."""
+    w = jnp.right_shift(jnp.maximum(v, 0), 5)
+    ok = (v >= 0) & (w < nwords)
+    word = words[jnp.where(ok, offset + w, 0)]
+    bit = jnp.right_shift(word, (v & 31).astype(jnp.uint32)) & jnp.uint32(1)
+    return ok & (bit == jnp.uint32(1))
+
+
+def _leaf_indices_block(pack: ServePack, Xb: jnp.ndarray, binned: bool):
+    """Leaf index per (tree, row) for one row block -> [T, rc] int32.
+
+    The per-depth step is the reference ``Tree::GetLeaf`` decision
+    (`tree.h:112-119` / ``NumericalDecision`` / ``CategoricalDecision``)
+    vectorized: one gather per node array, one ``where`` per select.
+    """
+    sf_arr = pack.split_feature_inner if binned else pack.split_feature
+
+    def one_tree(sf, thr, tb, dl, ic, mz, mn, lc, rc, co, cn, bo, bn):
+        node = jnp.zeros(Xb.shape[0], jnp.int32)
+
+        def body(_, node):
+            is_leaf = node < 0
+            nidx = jnp.maximum(node, 0)
+            f = sf[nidx]
+            v = jnp.take_along_axis(Xb, f[:, None], axis=1)[:, 0]
+            if binned:
+                b = v.astype(jnp.int32)
+                mt_f = pack.feat_missing_type[f]
+                is_missing = (
+                    ((mt_f == MISSING_NAN) & (b == pack.feat_nan_bin[f]))
+                    | ((mt_f == MISSING_ZERO) & (b == pack.feat_zero_bin[f])))
+                num_left = jnp.where(is_missing, dl[nidx], b <= tb[nidx])
+                cat_left = _bitset_member(pack.catbin_words, bo[nidx],
+                                          bn[nidx], b)
+            else:
+                v = v.astype(jnp.float32)
+                nan = jnp.isnan(v)
+                v0 = jnp.where(nan & ~mn[nidx], jnp.float32(0), v)
+                is_missing = ((mz[nidx]
+                               & (jnp.abs(v0) <= jnp.float32(_ZERO_EPS_F32)))
+                              | (mn[nidx] & nan))
+                num_left = jnp.where(is_missing, dl[nidx], v0 <= thr[nidx])
+                # CategoricalDecision: NaN / negative / huge -> not in set
+                cat = jnp.where(nan | (v < 0) | (v >= jnp.float32(2.0 ** 31)),
+                                jnp.float32(-1), v).astype(jnp.int32)
+                cat_left = _bitset_member(pack.cat_words, co[nidx],
+                                          cn[nidx], cat)
+            go_left = jnp.where(ic[nidx], cat_left, num_left)
+            nxt = jnp.where(go_left, lc[nidx], rc[nidx])
+            return jnp.where(is_leaf, node, nxt)
+
+        node = jax.lax.fori_loop(0, pack.max_depth, body, node)
+        return jnp.where(node < 0, ~node, 0)
+
+    return jax.vmap(one_tree)(
+        sf_arr, pack.threshold, pack.threshold_bin, pack.default_left,
+        pack.is_cat, pack.miss_zero, pack.miss_nan, pack.left_child,
+        pack.right_child, pack.cat_offset, pack.cat_nwords,
+        pack.catbin_offset, pack.catbin_nwords)
+
+
+def _accumulate(hi: jnp.ndarray, lo: jnp.ndarray, num_class: int):
+    """Neumaier-compensated f32 sum over trees in TREE ORDER -> [rc, K].
+
+    Tracks the exact f64 sequential accumulation (the oracle in
+    ``GBDT._predict_loaded``) to ~2^-45 relative before the final f32
+    rounding — the 1-ulp score contract."""
+    T, rc = hi.shape
+    s0 = jnp.zeros((num_class, rc), jnp.float32)
+    c0 = jnp.zeros((num_class, rc), jnp.float32)
+
+    def nadd(s_k, c_k, v):
+        t = s_k + v
+        err = jnp.where(jnp.abs(s_k) >= jnp.abs(v),
+                        (s_k - t) + v, (v - t) + s_k)
+        return t, c_k + err
+
+    def body(t, carry):
+        s, c = carry
+        k = jnp.mod(t, num_class)
+        s_k = jax.lax.dynamic_index_in_dim(s, k, 0, keepdims=False)
+        c_k = jax.lax.dynamic_index_in_dim(c, k, 0, keepdims=False)
+        s_k, c_k = nadd(s_k, c_k,
+                        jax.lax.dynamic_index_in_dim(hi, t, 0, keepdims=False))
+        s_k, c_k = nadd(s_k, c_k,
+                        jax.lax.dynamic_index_in_dim(lo, t, 0, keepdims=False))
+        s = jax.lax.dynamic_update_index_in_dim(s, s_k, k, 0)
+        c = jax.lax.dynamic_update_index_in_dim(c, c_k, k, 0)
+        return s, c
+
+    s, c = jax.lax.fori_loop(0, T, body, (s0, c0))
+    return (s + c).T
+
+
+def _row_blocks(X: jnp.ndarray, rchunk: int):
+    n = X.shape[0]
+    rc_sz = min(rchunk, max(n, 1))
+    RC = -(-n // rc_sz)
+    n_pad = RC * rc_sz
+    Xp = X if n_pad == n else jnp.concatenate(
+        [X, jnp.zeros((n_pad - n,) + X.shape[1:], X.dtype)])
+    return Xp.reshape((RC, rc_sz) + X.shape[1:]), n_pad
+
+
+@functools.partial(jax.jit, static_argnames=("num_class", "rchunk", "binned"))
+def _score_batch(pack: ServePack, X: jnp.ndarray, *, num_class: int,
+                 rchunk: int, binned: bool) -> jnp.ndarray:
+    """Raw scores for a whole batch in ONE dispatch -> [n, K] f32.
+    ``lax.map`` over row blocks bounds the [T, rchunk] walk state."""
+    n = X.shape[0]
+
+    def row_block(Xb):
+        leaves = _leaf_indices_block(pack, Xb, binned)
+        hi = jnp.take_along_axis(pack.leaf_hi, leaves, axis=1)
+        lo = jnp.take_along_axis(pack.leaf_lo, leaves, axis=1)
+        return _accumulate(hi, lo, num_class)
+
+    blocks, n_pad = _row_blocks(X, rchunk)
+    out = jax.lax.map(row_block, blocks)
+    return out.reshape(n_pad, num_class)[:n]
+
+
+@functools.partial(jax.jit, static_argnames=("rchunk", "binned"))
+def _leaf_batch(pack: ServePack, X: jnp.ndarray, *, rchunk: int,
+                binned: bool) -> jnp.ndarray:
+    """Per-tree leaf index per row (PredictLeafIndex) -> [n, T] int32."""
+    n = X.shape[0]
+
+    def row_block(Xb):
+        return _leaf_indices_block(pack, Xb, binned).T
+
+    blocks, n_pad = _row_blocks(X, rchunk)
+    out = jax.lax.map(row_block, blocks)
+    return out.reshape(n_pad, pack.num_trees)[:n]
+
+
+# ---------------------------------------------------------------------------
+# user-facing compiled model
+# ---------------------------------------------------------------------------
+def _default_rchunk() -> int:
+    try:
+        return int(os.environ.get("LGBM_TPU_SERVE_ROW_CHUNK", 16384))
+    except ValueError:
+        return 16384
+
+
+def next_bucket(n: int, min_bucket: int = 256) -> int:
+    """Smallest power-of-two bucket >= n (>= min_bucket): padding every
+    batch to a bucket keeps the set of compiled programs finite, so
+    steady-state serving never re-enters XLA."""
+    return max(min_bucket, 1 << max(n - 1, 0).bit_length())
+
+
+class CompiledModel:
+    """A booster compiled for device-resident scoring.
+
+    Construct via :func:`compile_model` (boosters) or
+    :func:`compile_trees` (bare tree lists).  All entry points pad the
+    batch to a power-of-two bucket by default (``pad=True``) so
+    repeated mixed-size calls reuse a small set of compiled programs.
+    """
+
+    def __init__(self, pack: ServePack, *, num_class: int = 1,
+                 objective=None, average_output: bool = False,
+                 base_score: float = 0.0, mappers=None,
+                 used_features: Optional[Sequence[int]] = None,
+                 num_features: Optional[int] = None,
+                 rchunk: Optional[int] = None, min_bucket: int = 256):
+        self.pack = pack
+        self.num_class = max(1, num_class)
+        self.objective = objective
+        self.average_output = average_output
+        self.base_score = float(base_score)
+        self.mappers = mappers
+        self.used_features = (list(used_features)
+                              if used_features is not None else None)
+        sf_max = int(np.asarray(pack.split_feature).max(initial=0))
+        self.num_features = int(num_features if num_features is not None
+                                else sf_max + 1)
+        self.rchunk = int(rchunk or _default_rchunk())
+        self.min_bucket = int(min_bucket)
+
+    # -- helpers ---------------------------------------------------------
+    @property
+    def num_trees(self) -> int:
+        return self.pack.num_trees
+
+    @property
+    def has_binned(self) -> bool:
+        return self.mappers is not None
+
+    def bin_rows(self, X: np.ndarray) -> np.ndarray:
+        """Bin raw rows through the TRAINING mappers (prediction-mode
+        sentinels for unseen categories) -> [n, Fi] uint8/int32 for the
+        binned fast path."""
+        if self.mappers is None:
+            raise ValueError("model was compiled without bin mappers; "
+                             "the binned fast path is unavailable")
+        X = np.asarray(X, np.float64)
+        inner = (self.used_features if self.used_features is not None
+                 else list(range(len(self.mappers))))
+        out = np.zeros((X.shape[0], max(len(inner), 1)), np.int32)
+        sentinel_max = 0
+        for j, f in enumerate(inner):
+            mp = self.mappers[f]
+            out[:, j] = mp.value_to_bin(X[:, f], prediction_mode=True)
+            sentinel_max = max(sentinel_max, mp.num_bin)
+        if sentinel_max <= np.iinfo(np.uint8).max:
+            return out.astype(np.uint8)     # the int8 fast-path payload
+        return out
+
+    def _prepare(self, X: np.ndarray, binned: bool, pad: bool):
+        if binned and self.mappers is None:
+            raise ValueError("model was compiled without bin mappers; "
+                             "the binned fast path is unavailable")
+        X = np.asarray(X)
+        if X.ndim == 1:
+            X = X[None, :]
+        want = (len(self.used_features) if binned and self.used_features
+                is not None else self.num_features)
+        if X.shape[1] < want:
+            raise ValueError(f"expected >= {want} feature columns, "
+                             f"got {X.shape[1]}")
+        if not binned:
+            X = np.ascontiguousarray(X, np.float32)
+        n = X.shape[0]
+        if pad:
+            bucket = next_bucket(n, self.min_bucket)
+            if bucket != n:
+                X = np.concatenate(
+                    [X, np.zeros((bucket - n,) + X.shape[1:], X.dtype)])
+        return X, n
+
+    # -- scoring ---------------------------------------------------------
+    def predict_raw(self, X: np.ndarray, *, binned: bool = False,
+                    pad: bool = True) -> np.ndarray:
+        """Raw scores [n] (or [n, K] multiclass), one device dispatch."""
+        Xp, n = self._prepare(X, binned, pad)
+        if self.num_trees == 0:
+            out = np.full((n, self.num_class), self.base_score, np.float64)
+            return out if self.num_class > 1 else out[:, 0]
+        with span("serve.score") as s:
+            s["rows"] = n
+            s["batch"] = int(Xp.shape[0])
+            out = np.asarray(_score_batch(
+                self.pack, jnp.asarray(Xp), num_class=self.num_class,
+                rchunk=self.rchunk, binned=binned))[:n]
+        counter_add("serve.rows", n)
+        return out if self.num_class > 1 else out[:, 0]
+
+    def predict(self, X: np.ndarray, raw_score: bool = False,
+                *, binned: bool = False, pad: bool = True) -> np.ndarray:
+        """Objective-transformed prediction (the ``Booster.predict``
+        contract: sigmoid/softmax applied unless ``raw_score``)."""
+        raw = self.predict_raw(X, binned=binned, pad=pad)
+        if raw_score or self.objective is None:
+            return raw
+        if self.average_output:
+            raw = raw / max(1, self.num_trees // self.num_class)
+        return np.asarray(self.objective.convert_output(jnp.asarray(raw)))
+
+    def leaf_indices(self, X: np.ndarray, *, binned: bool = False,
+                     pad: bool = True) -> np.ndarray:
+        """Per-tree leaf index per row -> [n, T] int32 (PredictLeafIndex)."""
+        Xp, n = self._prepare(X, binned, pad)
+        if self.num_trees == 0:
+            return np.zeros((n, 0), np.int32)
+        with span("serve.score") as s:
+            s["rows"] = n
+            s["leaf"] = True
+            return np.asarray(_leaf_batch(
+                self.pack, jnp.asarray(Xp), rchunk=self.rchunk,
+                binned=binned))[:n]
+
+    def warm(self, buckets: Sequence[int], *, binned: bool = False) -> None:
+        """Compile the scorer for each bucket size up front (the
+        serving warmup; afterwards mixed batch sizes hit the program
+        cache only)."""
+        F = (len(self.used_features) if binned and self.used_features
+             is not None else self.num_features)
+        dtype = np.uint8 if binned else np.float32
+        for b in sorted(set(int(v) for v in buckets)):
+            with span("serve.compile") as s:
+                s["bucket"] = b
+                self.predict_raw(np.zeros((b, F), dtype), binned=binned,
+                                 pad=False)
+
+
+def compile_trees(trees: Sequence[Tree], *, num_class: int = 1,
+                  objective=None, average_output: bool = False,
+                  base_score: float = 0.0, mappers=None,
+                  used_features: Optional[Sequence[int]] = None,
+                  num_features: Optional[int] = None,
+                  rchunk: Optional[int] = None,
+                  min_bucket: int = 256) -> CompiledModel:
+    """Compile a bare tree list (see :func:`compile_model` for boosters)."""
+    with span("serve.compile") as s:
+        s["trees"] = len(trees)
+        pack = build_pack(trees, mappers=mappers, used_features=used_features)
+    counter_add("serve.compiled_trees", len(trees))
+    return CompiledModel(pack, num_class=num_class, objective=objective,
+                         average_output=average_output, base_score=base_score,
+                         mappers=mappers, used_features=used_features,
+                         num_features=num_features, rchunk=rchunk,
+                         min_bucket=min_bucket)
+
+
+def compile_model(model: Any, num_iteration: int = -1, *,
+                  rchunk: Optional[int] = None,
+                  min_bucket: int = 256) -> CompiledModel:
+    """Compile a trained model for serving.
+
+    ``model`` is a ``Booster`` (trained in-process or loaded from the
+    reference text format) or a ``GBDT``.  ``num_iteration > 0``
+    truncates to the first ``num_iteration * num_tree_per_iteration``
+    trees — the single truncation seam shared by every predict surface.
+    The binned fast path is built when the model still carries its
+    training dataset (bin mappers); loaded models serve the raw path.
+    """
+    g = getattr(model, "_gbdt", model)
+    K = max(1, getattr(g, "num_tree_per_iteration", 1))
+    trees = list(g.models)
+    if num_iteration is not None and num_iteration > 0:
+        trees = trees[:num_iteration * K]
+    mappers = None
+    used = None
+    if getattr(g, "train_set", None) is not None:
+        mappers = g.train_set.mappers
+        used = g.train_set.used_features
+    num_features = getattr(g, "max_feature_idx", -1) + 1 or None
+    cm = compile_trees(
+        trees, num_class=K, objective=getattr(g, "objective", None),
+        average_output=bool(getattr(g, "average_output", False)),
+        base_score=float(getattr(g, "init_score_value", 0.0) or 0.0),
+        mappers=mappers, used_features=used, num_features=num_features,
+        rchunk=rchunk, min_bucket=min_bucket)
+    log_info(f"serve: compiled {len(trees)} trees "
+             f"(depth pad {cm.pack.max_depth}, "
+             f"binned={'yes' if cm.has_binned else 'no'})")
+    return cm

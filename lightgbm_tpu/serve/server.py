@@ -1,0 +1,313 @@
+"""Micro-batching async serving harness over a :class:`CompiledModel`.
+
+The north star is serving heavy traffic: many small concurrent
+prediction requests, one accelerator.  Dispatching each request alone
+wastes the device (a 1-row batch costs the same dispatch latency as a
+4096-row one); batching naively recompiles per batch shape.  This
+harness does the standard two things, instrumented end to end:
+
+* **micro-batching** — requests land in a queue; a worker thread
+  coalesces up to ``max_batch`` rows or ``max_wait_ms``, whichever
+  comes first, into one device dispatch;
+* **padding buckets** — every coalesced batch is padded to a
+  power-of-two bucket from a fixed set, all compiled during warmup, so
+  steady-state traffic NEVER re-enters XLA.  Under
+  ``LGBM_TPU_TRACE_CONTRACT=1`` the server runs its whole life under a
+  :class:`~lightgbm_tpu.obs.trace_contract.CompileTracker` and writes a
+  ``serve_trace_contract`` section into the telemetry summary — the
+  runtime proof of the zero-recompile property.
+
+Delivery contract: every accepted request is resolved EXACTLY once —
+with its scores, or (after the retry budget is exhausted, or on a
+non-transient fault) with the scoring exception.  Scoring runs through
+``utils/retry.retry_call`` under the ``serve.score`` fault point
+(``utils/faults.py``), so a mid-batch transient re-scores the whole
+batch (pure function — idempotent) without dropping or double-resolving
+any request.  ``close()`` drains the queue: requests accepted before
+shutdown are scored before the worker exits.
+
+Telemetry: ``serve.compile`` (warmup, per bucket), ``serve.batch``
+(one per coalesced dispatch, with rows/bucket/requests attrs),
+``serve.score`` (inside the model, one per device dispatch), counters
+``serve.requests/.rows/.batches/.padded_rows``.
+"""
+from __future__ import annotations
+
+import os
+import queue
+import threading
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..obs import counter_add, event, span, set_section
+from ..obs.trace_contract import CompileTracker, contract_enabled
+from ..utils.faults import fault_point
+from ..utils.log import log_info, log_warning
+from ..utils.retry import RetryPolicy, retry_call
+from .compiler import CompiledModel, next_bucket
+
+_SENTINEL = object()
+
+
+class _Request:
+    __slots__ = ("rows", "future", "t_enqueue")
+
+    def __init__(self, rows: np.ndarray):
+        self.rows = rows
+        self.future: Future = Future()
+        self.t_enqueue = time.perf_counter()
+
+
+def _default_buckets(max_batch: int, min_bucket: int) -> List[int]:
+    out = []
+    b = min_bucket
+    top = next_bucket(max_batch, min_bucket)
+    while b < top:
+        out.append(b)
+        b *= 4
+    out.append(top)
+    return out
+
+
+class PredictionServer:
+    """Async micro-batching front end for a compiled model.
+
+    ``submit(x)`` returns a ``concurrent.futures.Future`` resolving to
+    the prediction for ``x`` (one row ``[F]`` or a block ``[k, F]``);
+    ``predict(x)`` is the blocking convenience.  ``close()`` drains and
+    stops the worker.
+    """
+
+    def __init__(self, model: CompiledModel, *, max_batch: int = 4096,
+                 max_wait_ms: float = 2.0,
+                 buckets: Optional[Sequence[int]] = None,
+                 raw_score: bool = False, binned: bool = False,
+                 min_bucket: int = 64, warmup: bool = True,
+                 retry_policy: Optional[RetryPolicy] = None):
+        self.model = model
+        self.max_batch = int(max_batch)
+        self.max_wait_s = float(max_wait_ms) / 1e3
+        self.raw_score = raw_score
+        self.binned = binned
+        self.min_bucket = int(min_bucket)
+        self.buckets = sorted(set(int(b) for b in buckets)) if buckets \
+            else _default_buckets(self.max_batch, self.min_bucket)
+        self._retry = retry_policy
+        self._q: "queue.Queue" = queue.Queue()
+        self._lock = threading.Lock()
+        self._closed = False
+        self._n_submitted = 0
+        self._n_resolved = 0
+        self._n_failed = 0
+        self._n_batches = 0
+        self._n_rows = 0
+        self._n_padded = 0
+        self._latency: Dict[int, List[float]] = {}
+        self._carry: List[_Request] = []    # worker-only: batch overflow
+        # the runtime zero-recompile proof: a live tracker when the
+        # trace contract is armed (track_threads=False — the worker
+        # thread's compiles ARE the contract here, unlike training's
+        # background AOT upgrades)
+        self._tracker: Optional[CompileTracker] = None
+        if contract_enabled():
+            self._tracker = CompileTracker(track_threads=False).__enter__()
+        if warmup:
+            self.warm()
+        if self._tracker is not None:
+            self._tracker.mark_steady()
+        self._thread = threading.Thread(
+            target=self._run, name="lgbm-tpu-serve", daemon=True)
+        self._thread.start()
+
+    # -- lifecycle -------------------------------------------------------
+    def warm(self) -> None:
+        """Compile every bucket program (idempotent after the first)."""
+        self.model.warm(self.buckets, binned=self.binned)
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """Drain the queue, stop the worker, finalize the contract
+        report.  Requests submitted before close are scored; submit
+        afterwards raises."""
+        with self._lock:
+            if self._closed:
+                return
+            self._closed = True
+        self._q.put(_SENTINEL)
+        self._thread.join(timeout)
+        if self._tracker is not None:
+            self._tracker.__exit__(None, None, None)
+            rep = self._tracker.report()
+            set_section("serve_trace_contract", rep)
+            if not rep["steady_ok"]:
+                event("contract", "serve_recompile_after_warmup",
+                      count=rep["compiles_steady"],
+                      names=rep["steady_names"])
+                log_warning(
+                    f"serve trace contract violated: "
+                    f"{rep['compiles_steady']} recompile(s) after warmup "
+                    f"({', '.join(rep['steady_names'][:5])}) — a batch "
+                    f"shape escaped the padding buckets")
+            self._tracker = None
+        log_info(f"serve: drained ({self._n_resolved} resolved, "
+                 f"{self._n_failed} failed, {self._n_batches} batches)")
+
+    def __enter__(self) -> "PredictionServer":
+        return self
+
+    def __exit__(self, *exc) -> bool:
+        self.close()
+        return False
+
+    # -- request API -----------------------------------------------------
+    def submit(self, x: np.ndarray) -> Future:
+        rows = np.asarray(x)
+        if rows.ndim == 1:
+            rows = rows[None, :]
+        if not self.binned:
+            rows = np.ascontiguousarray(rows, np.float32)
+        with self._lock:
+            if self._closed:
+                raise RuntimeError("PredictionServer is closed")
+            self._n_submitted += 1
+        req = _Request(rows)
+        counter_add("serve.requests")
+        self._q.put(req)
+        return req.future
+
+    def predict(self, x: np.ndarray, timeout: Optional[float] = 60.0):
+        return self.submit(x).result(timeout)
+
+    def stats(self) -> Dict:
+        """Counts + per-bucket latency percentiles (ms)."""
+        with self._lock:
+            lat = {b: list(v) for b, v in self._latency.items()}
+            out = {
+                "submitted": self._n_submitted,
+                "resolved": self._n_resolved,
+                "failed": self._n_failed,
+                "batches": self._n_batches,
+                "rows": self._n_rows,
+                "padded_rows": self._n_padded,
+                "pending": self._n_submitted - self._n_resolved
+                           - self._n_failed,
+            }
+        out["latency_ms"] = {
+            b: {"count": len(v),
+                "p50": round(float(np.percentile(v, 50)) * 1e3, 3),
+                "p99": round(float(np.percentile(v, 99)) * 1e3, 3)}
+            for b, v in lat.items() if v}
+        return out
+
+    # -- worker ----------------------------------------------------------
+    def _collect(self, first: "_Request") -> List["_Request"]:
+        """Coalesce queued requests behind ``first`` up to max_batch
+        rows or the max-wait deadline.  A request that would overflow
+        ``max_batch`` (and so escape the warmed bucket set) is carried
+        into the NEXT batch instead — batches never outgrow the
+        largest bucket unless a single request already does."""
+        batch = [first]
+        rows = first.rows.shape[0]
+        deadline = time.perf_counter() + self.max_wait_s
+        while rows < self.max_batch:
+            wait = deadline - time.perf_counter()
+            try:
+                item = self._q.get(timeout=max(wait, 0.0)) if wait > 0 \
+                    else self._q.get_nowait()
+            except queue.Empty:
+                break
+            if item is _SENTINEL:
+                # keep draining after this batch; re-post so the outer
+                # loop sees the shutdown marker AFTER the queue empties
+                self._q.put(_SENTINEL)
+                break
+            if rows + item.rows.shape[0] > self.max_batch:
+                self._carry.append(item)
+                break
+            batch.append(item)
+            rows += item.rows.shape[0]
+        return batch
+
+    def _bucket_for(self, n: int) -> int:
+        """Smallest CONFIGURED bucket >= n.  Only a single request
+        larger than every bucket escapes the warmed set (padded to its
+        own power of two, compiled on first sight — and logged, since
+        that is a contract violation waiting to be sized away)."""
+        for b in self.buckets:
+            if n <= b:
+                return b
+        counter_add("serve.oversize_batches")
+        return next_bucket(n, self.min_bucket)
+
+    def _score(self, X: np.ndarray) -> np.ndarray:
+        fault_point("serve.score")
+        return self.model.predict(X, raw_score=self.raw_score,
+                                  binned=self.binned, pad=False)
+
+    def _run_batch(self, batch: List["_Request"]) -> None:
+        X = batch[0].rows if len(batch) == 1 else np.concatenate(
+            [r.rows for r in batch])
+        n = X.shape[0]
+        bucket = self._bucket_for(n)
+        if bucket != n:
+            X = np.concatenate(
+                [X, np.zeros((bucket - n,) + X.shape[1:], X.dtype)])
+        with span("serve.batch") as s:
+            s["rows"] = n
+            s["bucket"] = bucket
+            s["requests"] = len(batch)
+            try:
+                out = retry_call(self._score, X, policy=self._retry,
+                                 what="serve.score")
+            except Exception as exc:    # noqa: BLE001 - resolved into futures
+                log_warning(f"serve: batch of {len(batch)} request(s) "
+                            f"failed after retries: {exc}")
+                with self._lock:
+                    self._n_failed += len(batch)
+                for r in batch:
+                    r.future.set_exception(exc)
+                return
+        out = np.asarray(out)[:n]
+        now = time.perf_counter()
+        with self._lock:
+            self._n_batches += 1
+            self._n_rows += n
+            self._n_padded += bucket - n
+            lat = self._latency.setdefault(bucket, [])
+        counter_add("serve.batches")
+        counter_add("serve.rows_batched", n)
+        counter_add("serve.padded_rows", bucket - n)
+        off = 0
+        for r in batch:
+            k = r.rows.shape[0]
+            res = out[off:off + k]
+            off += k
+            with self._lock:
+                self._n_resolved += 1
+                if len(lat) < 100_000:
+                    lat.append(now - r.t_enqueue)
+            # exactly-once: a Future can only be resolved once — a
+            # retry re-scores the batch but delivery happens here, once
+            r.future.set_result(res[0] if k == 1 else res)
+
+    def _run(self) -> None:
+        draining = False
+        while True:
+            if self._carry:
+                item = self._carry.pop(0)
+            elif draining:
+                # drain anything still queued (pre-close requests are
+                # FIFO-ahead of the sentinel; a racing submit that beat
+                # the closed flag is also honored) then exit
+                try:
+                    item = self._q.get_nowait()
+                except queue.Empty:
+                    return
+            else:
+                item = self._q.get()
+            if item is _SENTINEL:
+                draining = True
+                continue
+            self._run_batch(self._collect(item))

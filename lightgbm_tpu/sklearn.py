@@ -177,12 +177,15 @@ class LGBMModel:
     def _transform_label(self, y):
         return y.astype(np.float32)
 
-    def predict(self, X, raw_score=False, num_iteration=None, **kwargs):
+    def predict(self, X, raw_score=False, num_iteration=None, device=None,
+                **kwargs):
+        """``device=True`` scores through the TPU-resident serving
+        predictor (``lightgbm_tpu/serve/``); see ``Booster.predict``."""
         if self._Booster is None:
             raise RuntimeError("fit() must be called before predict()")
         return self._Booster.predict(X, raw_score=raw_score,
                                      num_iteration=num_iteration or -1,
-                                     **kwargs)
+                                     device=device, **kwargs)
 
     # -- attributes ------------------------------------------------------
     @property
@@ -231,18 +234,22 @@ class LGBMClassifier(LGBMModel):
     def _transform_label(self, y):
         return np.asarray([self._label_map[v] for v in y], np.float32)
 
-    def predict(self, X, raw_score=False, num_iteration=None, **kwargs):
+    def predict(self, X, raw_score=False, num_iteration=None, device=None,
+                **kwargs):
         proba = self.predict_proba(X, raw_score=raw_score,
-                                   num_iteration=num_iteration, **kwargs)
+                                   num_iteration=num_iteration,
+                                   device=device, **kwargs)
         if raw_score or kwargs.get("pred_leaf") or kwargs.get("pred_contrib"):
             return proba
         if proba.ndim > 1:
             return self._classes[np.argmax(proba, axis=1)]
         return self._classes[(proba > 0.5).astype(int)]
 
-    def predict_proba(self, X, raw_score=False, num_iteration=None, **kwargs):
+    def predict_proba(self, X, raw_score=False, num_iteration=None,
+                      device=None, **kwargs):
         out = super().predict(X, raw_score=raw_score,
-                              num_iteration=num_iteration, **kwargs)
+                              num_iteration=num_iteration, device=device,
+                              **kwargs)
         if raw_score or kwargs.get("pred_leaf") or kwargs.get("pred_contrib"):
             return out
         if out.ndim == 1:

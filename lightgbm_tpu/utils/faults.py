@@ -16,7 +16,11 @@ the seams where production faults actually strike:
   is silently dropped (simulating rank-divergent control flow that
   skips a collective; armed per-rank by the desync-localization tests —
   the fault is CAUGHT inside ``obs/flight_recorder.record``, it never
-  propagates).
+  propagates),
+* ``serve.score``    — the serving harness's batched device dispatch
+  (``serve/server.py``: a TPU worker restart mid-batch); retried by the
+  shared policy, and the delivery contract (exactly-once per request)
+  must hold across the retry.
 
 Each point is a single ``fault_point(name)`` call that is a no-op unless
 armed.  Tests arm points programmatically (:func:`inject`, or the
@@ -41,7 +45,7 @@ import threading
 from typing import Dict, Optional
 
 POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
-          "loader.read", "spmd.skip_record")
+          "loader.read", "spmd.skip_record", "serve.score")
 
 
 class FaultInjected(RuntimeError):

@@ -78,11 +78,11 @@ def test_deadline_skips_aux_legs_with_markers(bench_run):
     final = _parse_lines(bench_run.stdout)[-1]
     assert "partial" not in final           # the complete line
     assert final["value"] > 0               # headline retained
-    for leg in ("valid", "bin255", "rank", "rank63"):
+    for leg in ("serve", "valid", "bin255", "rank", "rank63"):
         assert final.get(f"{leg}_leg") == "skipped: budget", final
     assert final.get("real_data") == "skipped: budget"
     assert set(final.get("legs_skipped", [])) >= {
-        "valid", "bin255", "rank", "rank63"}
+        "serve", "valid", "bin255", "rank", "rank63"}
     # an explicit skip is not a failure: no legs_failed / hard-failed
     assert "legs_failed" not in final
     assert "legs_hard_failed" not in final
@@ -114,6 +114,22 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
             assert r["compact_ns_per_row"] > 0
     assert out["north_star_parse_ok"] is True
     assert set(out["north_star_wave_buckets"]) >= {32, 64, 128}
+    # serve (predict) leg schema gate: the dryrun runs the REAL leg at
+    # toy shape and validates every field the TPU artifact will carry —
+    # rows/s, the host-traversal anchor, per-bucket p50/p99, and the
+    # parity + zero-recompile verdicts (PR 6 satellite)
+    assert out["serve_schema_ok"] is True, out
+    from bench import SERVE_SCHEMA_KEYS
+    for key in SERVE_SCHEMA_KEYS:
+        assert key in out, key
+    assert out["serve_rows_per_sec"] > 0
+    assert out["serve_host_rows_per_sec"] > 0
+    assert out["serve_parity_ok"] is True
+    assert out["serve_recompile_ok"] is True
+    assert out["serve_steady_recompiles"] == 0
+    assert out["serve_requests"] > 0
+    for rec in out["serve_latency_ms"].values():
+        assert rec["count"] > 0 and rec["p99"] >= rec["p50"] >= 0.0
 
 
 def test_north_star_wave_entries_parse():
@@ -141,7 +157,7 @@ def test_gate_bearing_hard_failure_zeroes_headline():
            "BENCH_ROWS": "2000", "BENCH_ITERS": "2",
            "BENCH_LEAVES": "7", "BENCH_BIN": "15",
            "BENCH_FULL": "0", "BENCH_255": "0", "BENCH_RANK": "0",
-           "BENCH_WAVES": "0",
+           "BENCH_WAVES": "0", "BENCH_SERVE": "0",
            "BENCH_FORCE_FAIL": "valid"}
     env.pop("XLA_FLAGS", None)
     env.pop("BENCH_DATA", None)

@@ -1,0 +1,465 @@
+"""The serving subsystem (``lightgbm_tpu/serve/``).
+
+Parity gate (property-style, the PR's acceptance contract):
+
+* leaf ROUTING from the compiled device predictor is BIT-EXACT against
+  the numpy oracle (``Tree.predict_leaf_batch`` / ``predict_row``)
+  across NaN/zero missing modes, categorical splits, stumps, and
+  models round-tripped through the reference text format;
+* SCORES are within 1 ulp (f32) of the f64 sequential accumulation
+  oracle (``GBDT._predict_loaded`` semantics);
+* the int8 binned fast path routes identically to the raw path.
+
+Plus: unified ``num_iteration`` truncation (multiclass included),
+the async server's delivery contract under injected faults (exactly
+once, drain on shutdown, no drops/doubles), and the trace contract
+(zero post-warmup recompiles across mixed batch sizes) under
+``LGBM_TPU_TRACE_CONTRACT=1``.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.models.tree import Tree
+from lightgbm_tpu.serve import (PredictionServer, compile_model,
+                                compile_trees, next_bucket)
+from lightgbm_tpu.utils import faults
+from lightgbm_tpu.utils.retry import RetryPolicy
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs():
+    obs.reset()
+    faults.clear()
+    yield
+    obs.reset()
+    faults.clear()
+
+
+FAST_RETRY = RetryPolicy(attempts=3, base_s=0.0, max_s=0.0, jitter=0.0)
+
+
+def _train(n=2500, f=6, nan_frac=0.0, seed=0, cat_cols=(), **params):
+    rng = np.random.RandomState(seed)
+    Xnum = rng.normal(size=(n, f)).astype(np.float32)
+    cols = [Xnum]
+    for _ in cat_cols:
+        cols.append(rng.randint(0, 25, size=(n, 1)).astype(np.float32))
+    X = np.concatenate(cols, axis=1) if len(cols) > 1 else Xnum
+    if nan_frac:
+        X[rng.rand(*X.shape) < nan_frac] = np.nan
+    y = (np.nan_to_num(X[:, 0]) + np.nan_to_num(X[:, 1])
+         + (X[:, f] % 3 == 1 if cat_cols else 0) > 0).astype(np.float32)
+    p = {"objective": "binary", "num_leaves": 15, "num_iterations": 8,
+         "max_bin": 63, "verbose": -1, "min_data_in_leaf": 5}
+    p.update(params)
+    cat = [f + i for i in range(len(cat_cols))] or "auto"
+    ds = lgb.Dataset(X, label=y, params=p, categorical_feature=cat)
+    bst = lgb.train(p, ds)
+    return bst, X, y
+
+
+def _query(bst, n=800, nan_frac=0.0, seed=1, cat_hi=30):
+    f = bst.num_feature()
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    # overwrite any categorical columns with ints incl. UNSEEN values
+    for t in bst._gbdt.models:
+        m = t.num_leaves - 1
+        for node in range(m):
+            if t.decision_type[node] & 1:
+                c = int(t.split_feature[node])
+                X[:, c] = rng.randint(-2, cat_hi, size=n).astype(np.float32)
+    if nan_frac:
+        X[rng.rand(n, f) < nan_frac] = np.nan
+    return X
+
+
+def _oracle(models, X, K=1):
+    """Sequential f64 accumulation — GBDT._predict_loaded semantics."""
+    X64 = np.asarray(X, np.float64)
+    out = np.zeros((X.shape[0], K))
+    for i, t in enumerate(models):
+        out[:, i % K] += t.predict_batch(X64)
+    return out if K > 1 else out[:, 0]
+
+
+def _assert_1ulp(dev, oracle):
+    diff = np.abs(np.asarray(dev, np.float64) - oracle)
+    ulp = np.spacing(np.abs(oracle).astype(np.float32)).astype(np.float64)
+    assert np.all(diff <= ulp), f"max {np.max(diff / ulp):.2f} ulp"
+
+
+def _assert_routing(cm, models, X, binned_input=None):
+    X64 = np.asarray(X, np.float64)
+    want = np.stack([t.predict_leaf_batch(X64) for t in models], axis=1)
+    got = cm.leaf_indices(X)
+    assert np.array_equal(got, want)
+    if binned_input is not None:
+        got_b = cm.leaf_indices(binned_input, binned=True)
+        assert np.array_equal(got_b, want)
+    # spot-check the per-row oracle too (predict_row == batch oracle)
+    for r in (0, len(X) // 2, len(X) - 1):
+        for j, t in enumerate(models):
+            assert t.predict_leaf_row(X64[r]) == want[r, j]
+
+
+# ---------------------------------------------------------------------------
+# parity gate
+# ---------------------------------------------------------------------------
+def test_parity_nan_missing():
+    bst, _, _ = _train(nan_frac=0.15)
+    cm = compile_model(bst)
+    Xq = _query(bst, nan_frac=0.15)
+    _assert_routing(cm, bst._gbdt.models, Xq, binned_input=cm.bin_rows(Xq))
+    _assert_1ulp(cm.predict_raw(Xq), _oracle(bst._gbdt.models, Xq))
+
+
+def test_parity_zero_as_missing():
+    bst, _, _ = _train(seed=3, zero_as_missing=True)
+    cm = compile_model(bst)
+    Xq = _query(bst, seed=4)
+    Xq[np.random.RandomState(5).rand(*Xq.shape) < 0.2] = 0.0
+    _assert_routing(cm, bst._gbdt.models, Xq, binned_input=cm.bin_rows(Xq))
+    _assert_1ulp(cm.predict_raw(Xq), _oracle(bst._gbdt.models, Xq))
+
+
+def test_parity_categorical_unseen():
+    bst, _, _ = _train(seed=7, cat_cols=(0, 1), num_iterations=10)
+    assert any(t.num_cat > 0 for t in bst._gbdt.models)
+    cm = compile_model(bst)
+    Xq = _query(bst, nan_frac=0.05, seed=8, cat_hi=40)  # unseen cats + NaN
+    _assert_routing(cm, bst._gbdt.models, Xq, binned_input=cm.bin_rows(Xq))
+    _assert_1ulp(cm.predict_raw(Xq), _oracle(bst._gbdt.models, Xq))
+
+
+def test_parity_stump_forest():
+    """num_leaves == 1 stumps (no split found) route every row to
+    leaf 0 and contribute their constant."""
+    t1 = Tree(max_leaves=2)
+    t1.leaf_value[0] = 0.625
+    t2 = Tree(max_leaves=2)
+    t2.leaf_value[0] = -1.0 / 3.0
+    cm = compile_trees([t1, t2])
+    X = np.random.RandomState(0).normal(size=(64, 3)).astype(np.float32)
+    assert np.array_equal(cm.leaf_indices(X), np.zeros((64, 2), np.int32))
+    _assert_1ulp(cm.predict_raw(X), _oracle([t1, t2], X))
+
+
+def test_parity_reference_text_roundtrip():
+    """The acceptance model class: a model serialized to the reference
+    text format and loaded back (no training dataset, no bin mappers)
+    compiles to the raw path and stays bit-exact in routing."""
+    bst, _, _ = _train(nan_frac=0.1, cat_cols=(0,), num_iterations=10)
+    loaded = lgb.Booster(model_str=bst.model_to_string())
+    cm = compile_model(loaded)
+    assert not cm.has_binned           # text model carries no mappers
+    Xq = _query(bst, nan_frac=0.1, seed=9)
+    _assert_routing(cm, loaded._gbdt.models, Xq)
+    _assert_1ulp(cm.predict_raw(Xq), _oracle(loaded._gbdt.models, Xq))
+    # and the loaded Booster's own device surface agrees with its host path
+    host = loaded.predict(Xq, raw_score=True)
+    dev = loaded.predict(Xq, raw_score=True, device=True)
+    np.testing.assert_allclose(dev, host, atol=1e-6, rtol=1e-6)
+
+
+def test_binned_fast_path_int8_and_equality():
+    bst, _, _ = _train(nan_frac=0.1)
+    cm = compile_model(bst)
+    Xq = _query(bst, nan_frac=0.1)
+    bins = cm.bin_rows(Xq)
+    assert bins.dtype == np.uint8      # the int8 payload at max_bin=63
+    assert np.array_equal(cm.predict_raw(bins, binned=True),
+                          cm.predict_raw(Xq))
+
+
+def test_one_dispatch_large_batch():
+    """A >=1M-row batch scores in ONE device dispatch (one serve.score
+    span) and matches the oracle on sampled rows."""
+    bst, _, _ = _train(n=1500, f=4, num_iterations=6, num_leaves=7)
+    cm = compile_model(bst)
+    n = 1_050_000
+    Xq = np.random.RandomState(2).normal(size=(n, 4)).astype(np.float32)
+    obs.reset()
+    obs.enable()
+    out = cm.predict_raw(Xq)
+    spans = obs.summary()["spans"]
+    assert spans["serve.score"]["count"] == 1
+    assert out.shape == (n,)
+    idx = np.linspace(0, n - 1, 201).astype(np.int64)
+    _assert_1ulp(out[idx], _oracle(bst._gbdt.models, Xq[idx]))
+
+
+# ---------------------------------------------------------------------------
+# truncation semantics (satellite: unified num_iteration slicing)
+# ---------------------------------------------------------------------------
+def test_truncation_unified_multiclass():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(900, 5)).astype(np.float32)
+    y = rng.randint(0, 3, size=900).astype(np.float32)
+    p = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+         "num_iterations": 6, "verbose": -1, "min_data_in_leaf": 5}
+    bst = lgb.train(p, lgb.Dataset(X, label=y, params=p))
+    g = bst._gbdt
+    assert g.num_tree_per_iteration == 3 and len(g.models) == 18
+    Xq = rng.normal(size=(200, 5)).astype(np.float32)
+    # pred_leaf truncation now happens in GBDT.predict_leaf: exactly
+    # num_iteration * K columns, equal to the full walk's prefix
+    full = bst.predict(Xq, pred_leaf=True)
+    cut = bst.predict(Xq, num_iteration=2, pred_leaf=True)
+    assert cut.shape == (200, 6)
+    assert np.array_equal(cut, full[:, :6])
+    # raw truncation matches the oracle over the same prefix
+    raw2 = bst.predict(Xq, num_iteration=2, raw_score=True)
+    np.testing.assert_allclose(
+        raw2, _oracle(g.models[:6], Xq, K=3), atol=1e-5)
+    # device path slices identically (compiled per truncation)
+    dev2 = bst.predict(Xq, num_iteration=2, raw_score=True, device=True)
+    np.testing.assert_allclose(dev2, raw2, atol=1e-5)
+    dev_leaf2 = bst.predict(Xq, num_iteration=2, pred_leaf=True,
+                            device=True)
+    assert np.array_equal(dev_leaf2, cut)
+    # best_iteration drives the default exactly like explicit slicing
+    bst.best_iteration = 2
+    np.testing.assert_allclose(bst.predict(Xq, raw_score=True), raw2,
+                               atol=0)
+    assert np.array_equal(bst.predict(Xq, pred_leaf=True), cut)
+
+
+def test_truncation_roundtrip_vs_saved_model():
+    bst, _, _ = _train(num_iterations=7)
+    Xq = _query(bst, n=300)
+    cut = lgb.Booster(model_str=bst.model_to_string(num_iteration=3))
+    # trained booster scores via the binned matmul path (f32 hi/lo),
+    # the loaded one via the f64 host walk — f32-level agreement
+    np.testing.assert_allclose(
+        bst.predict(Xq, num_iteration=3, raw_score=True),
+        cut.predict(Xq, raw_score=True), atol=2e-5, rtol=1e-5)
+    # the DEVICE paths of both slice identically and agree to 1 ulp
+    np.testing.assert_array_equal(
+        bst.predict(Xq, num_iteration=3, pred_leaf=True, device=True),
+        cut.predict(Xq, pred_leaf=True, device=True))
+
+
+# ---------------------------------------------------------------------------
+# surfaces: Booster(device=), sklearn, engine.predict, C API
+# ---------------------------------------------------------------------------
+def test_booster_device_matches_host():
+    bst, _, _ = _train(nan_frac=0.1)
+    Xq = _query(bst, nan_frac=0.1)
+    for raw in (True, False):
+        host = bst.predict(Xq, raw_score=raw)
+        dev = bst.predict(Xq, raw_score=raw, device=True)
+        np.testing.assert_allclose(dev, host, atol=2e-5, rtol=1e-5)
+    # the compiled pack is cached per (length, truncation)
+    cm1 = bst._device_predictor(-1)
+    assert bst._device_predictor(-1) is cm1
+
+
+def test_booster_device_env_default(monkeypatch):
+    bst, _, _ = _train(n=600, num_iterations=3)
+    Xq = _query(bst, n=100)
+    host = bst.predict(Xq, raw_score=True)
+    monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE", "1")
+    dev = bst.predict(Xq, raw_score=True)       # device by default now
+    np.testing.assert_allclose(dev, host, atol=2e-5, rtol=1e-5)
+    assert getattr(bst, "_serve_cache", None)   # proved it took serve path
+
+
+def test_sklearn_device_passthrough():
+    rng = np.random.RandomState(1)
+    X = rng.normal(size=(800, 5))
+    y = (X[:, 0] > 0).astype(int)
+    clf = lgb.LGBMClassifier(n_estimators=5, num_leaves=7)
+    clf.fit(X, y)
+    p_host = clf.predict_proba(X[:100])
+    p_dev = clf.predict_proba(X[:100], device=True)
+    np.testing.assert_allclose(p_dev, p_host, atol=2e-5, rtol=1e-5)
+    assert np.array_equal(clf.predict(X[:100], device=True),
+                          clf.predict(X[:100]))
+
+
+def test_engine_predict_surface(tmp_path):
+    bst, _, _ = _train(n=600, num_iterations=3)
+    Xq = _query(bst, n=100)
+    want = bst.predict(Xq)
+    np.testing.assert_allclose(lgb.predict(bst, Xq), want, atol=0)
+    np.testing.assert_allclose(
+        lgb.predict(bst.model_to_string(), Xq), want, atol=2e-5, rtol=1e-5)
+    path = str(tmp_path / "m.txt")
+    bst.save_model(path)
+    np.testing.assert_allclose(
+        lgb.predict(path, Xq, device=True), want, atol=5e-5, rtol=1e-4)
+    with pytest.raises(TypeError):
+        lgb.predict(12345, Xq)
+
+
+def test_capi_device_env(monkeypatch):
+    import ctypes
+    from lightgbm_tpu import capi_bridge as cb
+    bst, _, _ = _train(n=600, num_iterations=3)
+    h = cb._put(bst)
+    Xq = np.ascontiguousarray(_query(bst, n=50), np.float64)
+    want = bst.predict(Xq)
+    out = np.zeros(50, np.float64)
+    monkeypatch.setenv("LGBM_TPU_CAPI_DEVICE", "1")
+    n = cb.booster_predict_for_mat(
+        h, Xq.ctypes.data, cb._DTYPE_FLOAT64, 50, Xq.shape[1], 1,
+        cb._PREDICT_NORMAL, -1, out.ctypes.data)
+    assert n == 50
+    np.testing.assert_allclose(out, want, atol=2e-5, rtol=1e-5)
+    cb.free_handle(h)
+
+
+# ---------------------------------------------------------------------------
+# server robustness (satellite: serve.score fault point)
+# ---------------------------------------------------------------------------
+def _server_model():
+    bst, _, _ = _train(n=800, f=4, num_iterations=4, num_leaves=7)
+    return bst, compile_model(bst)
+
+
+def test_server_mixed_sizes_and_latency():
+    bst, cm = _server_model()
+    rng = np.random.RandomState(3)
+    with PredictionServer(cm, max_batch=256, max_wait_ms=1.0,
+                          buckets=(64, 256), min_bucket=64,
+                          raw_score=True) as srv:
+        reqs = [rng.normal(size=(k, 4)).astype(np.float32)
+                for k in (1, 5, 40, 1, 120, 7, 256)]
+        futs = [srv.submit(r) for r in reqs]
+        for r, fu in zip(reqs, futs):
+            want = cm.predict_raw(r)
+            got = fu.result(60)
+            np.testing.assert_array_equal(
+                np.atleast_1d(got), np.atleast_1d(want))
+        st = srv.stats()
+    assert st["resolved"] == len(reqs) and st["failed"] == 0
+    assert st["pending"] == 0
+    assert st["latency_ms"]                    # per-bucket percentiles
+    for rec in st["latency_ms"].values():
+        assert rec["p99"] >= rec["p50"] >= 0.0
+    spans = obs.summary()["spans"] if obs.enabled() else {}
+    # batches never exceeded the configured buckets
+    assert set(st["latency_ms"]) <= {64, 256}
+
+
+def test_server_fault_retries_no_drop_no_double():
+    """A mid-batch transient fault retries through utils/retry and
+    every request still resolves exactly once with correct scores."""
+    obs.enable()
+    bst, cm = _server_model()
+    rng = np.random.RandomState(4)
+    reqs = [rng.normal(size=(k, 4)).astype(np.float32)
+            for k in (3, 9, 2, 50, 1)]
+    faults.inject("serve.score", times=1)        # transient (UNAVAILABLE)
+    with PredictionServer(cm, max_batch=128, max_wait_ms=1.0,
+                          buckets=(128,), min_bucket=128, raw_score=True,
+                          retry_policy=FAST_RETRY) as srv:
+        futs = [srv.submit(r) for r in reqs]
+        results = [fu.result(60) for fu in futs]
+        st = srv.stats()
+    assert faults.fired("serve.score") == 1
+    for r, got in zip(reqs, results):
+        np.testing.assert_array_equal(np.atleast_1d(got),
+                                      np.atleast_1d(cm.predict_raw(r)))
+    # exactly once: every request resolved, none failed, none pending
+    assert st["resolved"] == len(reqs)
+    assert st["failed"] == 0 and st["pending"] == 0
+    c = obs.summary()["counters"]
+    assert c.get("retry.serve.score.recovered", 0) >= 1
+
+
+def test_server_nontransient_fails_fast_and_delivers_errors():
+    bst, cm = _server_model()
+    faults.inject("serve.score", times=1, transient=False)
+    with PredictionServer(cm, max_batch=64, max_wait_ms=0.5,
+                          buckets=(64,), min_bucket=64, raw_score=True,
+                          retry_policy=FAST_RETRY) as srv:
+        fu = srv.submit(np.zeros((2, 4), np.float32))
+        with pytest.raises(faults.FaultInjected):
+            fu.result(60)
+        st = srv.stats()
+    assert faults.fired("serve.score") == 1      # no retry on PERMANENT
+    assert st["failed"] == 1 and st["pending"] == 0
+    # the server keeps serving after a failed batch
+    # (new server: previous one is closed)
+
+
+def test_server_drain_on_shutdown():
+    bst, cm = _server_model()
+    rng = np.random.RandomState(5)
+    srv = PredictionServer(cm, max_batch=64, max_wait_ms=50.0,
+                           buckets=(64,), min_bucket=64, raw_score=True)
+    futs = [srv.submit(rng.normal(size=(2, 4)).astype(np.float32))
+            for _ in range(30)]
+    srv.close()                       # immediate close must drain, not drop
+    for fu in futs:
+        assert fu.result(60) is not None
+    st = srv.stats()
+    assert st["resolved"] == 30 and st["pending"] == 0
+    with pytest.raises(RuntimeError):
+        srv.submit(np.zeros((1, 4), np.float32))
+
+
+def test_server_exhausted_retries_deliver_exception():
+    bst, cm = _server_model()
+    faults.inject("serve.score", times=10)       # outlives the budget
+    with PredictionServer(cm, max_batch=64, max_wait_ms=0.5,
+                          buckets=(64,), min_bucket=64, raw_score=True,
+                          retry_policy=FAST_RETRY) as srv:
+        fu = srv.submit(np.zeros((2, 4), np.float32))
+        with pytest.raises(faults.FaultInjected):
+            fu.result(60)
+        st = srv.stats()
+    assert st["failed"] == 1 and st["pending"] == 0
+    assert faults.fired("serve.score") == FAST_RETRY.attempts
+
+
+# ---------------------------------------------------------------------------
+# telemetry + trace contract (satellite)
+# ---------------------------------------------------------------------------
+def test_serve_spans_and_counters_in_summary():
+    obs.enable()
+    bst, cm = _server_model()
+    with PredictionServer(cm, max_batch=64, buckets=(64,), min_bucket=64,
+                          raw_score=True) as srv:
+        srv.predict(np.zeros((3, 4), np.float32))
+    s = obs.summary()
+    for name in ("serve.compile", "serve.batch", "serve.score"):
+        assert s["spans"].get(name, {}).get("count", 0) >= 1, name
+    assert s["counters"]["serve.requests"] == 1
+    assert s["counters"]["serve.batches"] == 1
+
+
+def test_trace_contract_zero_recompiles_mixed_sizes(monkeypatch):
+    """Tier-1 serving contract: under LGBM_TPU_TRACE_CONTRACT=1 the
+    server's own tracker reports ZERO post-warmup recompiles across
+    mixed batch sizes — the padding buckets doing their job."""
+    monkeypatch.setenv("LGBM_TPU_TRACE_CONTRACT", "1")
+    obs.reset()
+    bst, cm = _server_model()
+    import jax
+    jax.clear_caches()       # earlier tests warmed these bucket shapes
+    rng = np.random.RandomState(6)
+    srv = PredictionServer(cm, max_batch=256, max_wait_ms=1.0,
+                           buckets=(64, 256), min_bucket=64,
+                           raw_score=True)
+    futs = [srv.submit(rng.normal(size=(k, 4)).astype(np.float32))
+            for k in (1, 3, 17, 64, 100, 2, 250, 9, 33, 1)]
+    for fu in futs:
+        fu.result(60)
+    srv.close()
+    rep = obs.summary().get("serve_trace_contract")
+    assert rep is not None
+    assert rep["compiles_warmup"] > 0            # warmup did compile
+    assert rep["steady_ok"], rep                 # ...and steady never did
+    assert rep["compiles_steady"] == 0
+
+
+def test_bucket_padding_helper():
+    assert next_bucket(1, 64) == 64
+    assert next_bucket(64, 64) == 64
+    assert next_bucket(65, 64) == 128
+    assert next_bucket(1_000_000, 256) == 1 << 20
