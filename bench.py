@@ -5,13 +5,25 @@ Prints JSON lines of the form:
 
 Emission is INCREMENTAL (VERDICT r5 Weak #1: round 5's driver timeout
 mid-ranking-leg erased every leg that had already passed): a parseable
-line is printed+flushed right after the 1M headline leg, again after
-the 10.5M full leg, and finally the complete line — a driver that
-takes the LAST parseable line can kill the process at any point after
-the headline without losing it.  ``BENCH_DEADLINE_S`` (seconds from
+line is printed+flushed right after the 1M headline leg, after the
+10.5M full leg, after EVERY aux leg (success, failure, or skip — PR 7),
+and finally the complete line — a driver that takes the LAST parseable
+line can kill the process at any point after the headline without
+losing anything that already ran.  ``BENCH_DEADLINE_S`` (seconds from
 process start; 0 = off) is a global budget: once exceeded, remaining
 auxiliary legs are recorded as ``"skipped: budget"`` instead of
 running, so the final line always lands inside the driver budget.
+Aux legs run in never-captured-first order: multichip (device-count
+guarded, see below), bin255, rank63, serve, rank, valid.
+
+Multi-chip (PR 7, ROADMAP item 1): the ``multichip`` leg trains the
+HIGGS-shape legs data-parallel on 2/4/8-chip meshes with the
+overlapped wave reduction on/off (``LGBM_TPU_OVERLAP``), recording
+per-chip scaling efficiency against the 1-chip serial anchor and a
+byte-identity parity gate between the two schedules.  On a 1-chip
+image it records ``"skipped: devices"`` without touching the
+single-chip headline; ``--dryrun`` re-execs it on a 2-device virtual
+CPU pool as the tier-1 mechanics gate.
 
 Quality gates: the synthetic legs' train AUC must clear ``AUC_GATE``
 (``BENCH_AUC_GATE``, default 0.93 — calibrated from the recorded
@@ -307,7 +319,8 @@ def valid_leg(leaves, max_bin, f=28):
             "valid_on_block_path": bool(it_spans == 0 and blocks > 0)}
 
 
-def wave_microbench(dryrun: bool = False):
+def wave_microbench(dryrun: bool = False, f: int = None, max_bin: int = None,
+                    buckets=(8, 32, 64, 128), rows: int = None):
     """ns/row per active-slot bucket for the wide one-hot kernel and the
     leaf-compacted kernel (`ops/compact.py`) — the deep-wave regression
     class `tests/data/north_star.json` first quantified (1.1 ns/row at
@@ -317,7 +330,13 @@ def wave_microbench(dryrun: bool = False):
     "compact_ns_per_row": ...}`` (compact only above the slot
     threshold).  On TPU this times real dispatches at 1M rows; in
     ``dryrun`` (or off-TPU) it runs interpret-mode kernels at toy shape
-    — the TABLE mechanics and kernel paths, not throughput."""
+    — the TABLE mechanics and kernel paths, not throughput.
+
+    ``f``/``max_bin``/``buckets``/``rows`` override the default
+    HIGGS-shape config so the same harness records the 255-bin and
+    MSLR-shape (136 features x 255 bins) tables — the reference's own
+    headline configs where the last driver capture still loses
+    (``north_star.json`` ``wave_kernel_255`` / ``wave_kernel_mslr``)."""
     import jax
     import jax.numpy as jnp
     from lightgbm_tpu.ops.compact import (compact_slot_threshold,
@@ -326,10 +345,12 @@ def wave_microbench(dryrun: bool = False):
                                                    pack_values,
                                                    transpose_bins)
     interp = dryrun or jax.default_backend() != "tpu"
-    n = int(os.environ.get("BENCH_WAVE_ROWS",
-                           2048 if interp else 1_000_000))
-    f = 4 if interp else 28
-    max_bin = 15 if interp else 63
+    n = rows if rows is not None else int(os.environ.get(
+        "BENCH_WAVE_ROWS", 2048 if interp else 1_000_000))
+    if f is None:
+        f = 4 if interp else 28
+    if max_bin is None:
+        max_bin = 15 if interp else 63
     L = 255
     reps = 1 if interp else 4
     rng = np.random.RandomState(9)
@@ -352,7 +373,7 @@ def wave_microbench(dryrun: bool = False):
         return (time.time() - t0) / reps / n * 1e9
 
     table = []
-    for A in (8, 32, 64, 128):
+    for A in buckets:
         active = jnp.asarray(
             (np.arange(A, dtype=np.int32) * max(1, L // A)) % L)
         row = {"active": A, "wide_ns_per_row": round(timed(
@@ -489,6 +510,303 @@ def serve_leg(dryrun: bool = False):
     }
 
 
+# extra wave-table shapes: the reference's own headline configs where
+# the last capture still loses (ROADMAP item 2) — recorded so the
+# losing regime (255-leaf split-find/routing vs histogram vs lambdarank
+# grads) is attributable per bucket.  Keys land in north_star.json.
+WAVE_AUX_SHAPES = {
+    # the exact docs/Experiments.rst HIGGS config (255 bins)
+    "wave_kernel_255": {"features": 28, "max_bin": 255},
+    # MSLR-shape: 136 features x 255 bins (lambdarank leg's store)
+    "wave_kernel_mslr": {"features": 136, "max_bin": 255},
+}
+
+
+def wave_aux_tables(dryrun: bool = False):
+    """The 255-bin / MSLR-shape wave tables (see WAVE_AUX_SHAPES).  In
+    dryrun the shapes shrink to interpret-safe toys (255 bins kept —
+    that is the regime under test; feature counts reduced) and only the
+    boundary buckets run: mechanics + kernel-path validation, not
+    throughput."""
+    out = {}
+    for key, spec in WAVE_AUX_SHAPES.items():
+        if dryrun:
+            out[key] = wave_microbench(
+                dryrun=True, f=min(4, spec["features"]),
+                max_bin=spec["max_bin"], buckets=(8, 128), rows=512)
+        else:
+            out[key] = wave_microbench(
+                dryrun=False, f=spec["features"], max_bin=spec["max_bin"])
+    return out
+
+
+# keys every multichip leg result must emit when the leg RUNS —
+# `--dryrun` validates this schema on a 2-device virtual CPU pool as
+# the tier-1 mechanics gate (tests/test_bench_budget).  On a 1-chip
+# image the leg instead records {"multichip_leg": "skipped: devices"}
+# and never touches the single-chip headline.
+MULTICHIP_SCHEMA_KEYS = (
+    "multichip_devices_visible", "multichip_device_kind",
+    "multichip_rows", "multichip_iters", "multichip_leaves",
+    "multichip_max_bin", "multichip_overlap_chunks",
+    "multichip_serial_row_iters_per_sec", "multichip_table",
+    "multichip_parity_ok", "multichip_best_vs_baseline")
+
+
+def _mc_train_rate(ds, y, n, iters, leaves, max_bin, ndev, overlap):
+    """Train ``iters`` data-parallel iterations on an ``ndev``-device
+    mesh with the overlapped reduction on/off; -> (row_iters/s, auc,
+    phases, model_text).  The model text backs the bit-parity gate:
+    overlap on/off must produce byte-identical models (the
+    serial-psum-schedule equivalence the overlap lowering guarantees)."""
+    from lightgbm_tpu.basic import Booster
+    prev = os.environ.get("LGBM_TPU_OVERLAP")
+    os.environ["LGBM_TPU_OVERLAP"] = "1" if overlap else "0"
+    try:
+        params = {"objective": "binary", "num_leaves": leaves,
+                  "max_bin": max_bin, "learning_rate": 0.1,
+                  "min_data_in_leaf": 20, "verbose": -1,
+                  "tree_learner": "data", "mesh_shape": [ndev]}
+        bst = Booster(params=params, train_set=ds)
+        g = bst._gbdt
+        # the mesh path dispatches per iteration (no fused block), so
+        # the compile split is the warm phase's wall clock, not the
+        # gbdt.block_compile span
+        warm = min(iters, 4)
+        t0 = time.time()
+        bst.update()
+        g.train_block(warm - 1)
+        _sync(g.scores)
+        warm_s = time.time() - t0
+        t0 = time.time()
+        g.train_block(iters)
+        _sync(g.scores)
+        wall = time.time() - t0
+        auc = float(_auc(y, np.asarray(g.scores[:, 0])))
+        model = g.save_model_to_string()
+        phases = {"warm_s": round(warm_s, 3),
+                  "steady_s": round(wall, 3)}
+        del bst, g
+        import gc
+        gc.collect()
+        return n * iters / wall, auc, phases, model
+    finally:
+        if prev is None:
+            os.environ.pop("LGBM_TPU_OVERLAP", None)
+        else:
+            os.environ["LGBM_TPU_OVERLAP"] = prev
+
+
+def multichip_leg(line=None, dryrun: bool = False):
+    """Data-parallel training across a REAL >=2-chip mesh: per-chip
+    scaling efficiency + overlap on/off row-iters/s — the ROADMAP item
+    1 north-star measurement (projected 8-chip 14.5x vs the 3.0x
+    target was, until this leg, arithmetic only).
+
+    Device-count guarded: on a 1-chip/CPU image it records
+    ``"skipped: devices"`` and NEVER zeroes the single-chip headline.
+    In ``--dryrun`` on a 1-device image it re-execs itself on a
+    2-device virtual CPU pool (``--multichip-child``) so the mesh
+    mechanics, schema, and the overlap bit-parity gate run as a tier-1
+    gate without TPU hardware.
+
+    Per mesh size d: row_iters/s with the double-buffered chunked
+    reduction ON (the production schedule) and OFF (the serial-psum
+    A/B), ``scaling_efficiency`` = rate_on / (d x serial_rate) against
+    the 1-chip serial path (the production single-chip anchor, fused
+    blocks), and the overlap on/off models compared byte-for-byte
+    (``multichip_parity_ok`` — a parity break zeroes the headline:
+    a wrong-answer speedup must not score).  Results are emitted
+    incrementally per mesh size when ``line`` is given."""
+    import jax
+    ndev = len(jax.devices())
+    if ndev < 2:
+        if not dryrun:
+            return {"multichip_leg": "skipped: devices",
+                    "multichip_devices_visible": ndev}
+        # dryrun mechanics gate: re-exec on a 2-device virtual CPU pool
+        import subprocess
+        import sys
+        env = dict(os.environ)
+        env["JAX_PLATFORMS"] = "cpu"
+        flags = [x for x in env.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in x]
+        flags.append("--xla_force_host_platform_device_count=2")
+        env["XLA_FLAGS"] = " ".join(flags)
+        # a force-registered single-TPU tunnel plugin would override
+        # JAX_PLATFORMS=cpu; drop its triggers (same dance as
+        # __graft_entry__._virtual_cpu_env)
+        env.pop("PALLAS_AXON_POOL_IPS", None)
+        if "PYTHONPATH" in env:
+            env["PYTHONPATH"] = os.pathsep.join(
+                p for p in env["PYTHONPATH"].split(os.pathsep)
+                if p and ".axon_site" not in os.path.basename(p.rstrip("/")))
+        here = os.path.dirname(os.path.abspath(__file__))
+        env["PYTHONPATH"] = here + os.pathsep + env.get("PYTHONPATH", "")
+        r = subprocess.run(
+            [sys.executable, os.path.join(here, "bench.py"),
+             "--multichip-child"],
+            env=env, cwd=here, capture_output=True, text=True, timeout=360)
+        for ln in reversed(r.stdout.splitlines()):
+            if ln.startswith("MULTICHIP_CHILD:"):
+                out = json.loads(ln[len("MULTICHIP_CHILD:"):])
+                out["multichip_dryrun_child"] = True
+                return out
+        raise RuntimeError(
+            f"multichip dryrun child produced no result "
+            f"(rc={r.returncode}): {r.stdout[-1000:]} {r.stderr[-2000:]}")
+
+    import gc
+    import lightgbm_tpu as lgb
+    n = int(os.environ.get("BENCH_MC_ROWS", 2_000 if dryrun else 1_000_000))
+    iters = int(os.environ.get("BENCH_MC_ITERS", 2 if dryrun else 48))
+    leaves = int(os.environ.get("BENCH_MC_LEAVES", 7 if dryrun else 255))
+    max_bin = int(os.environ.get("BENCH_MC_BIN", 15 if dryrun else 63))
+    f = 8 if dryrun else 28
+    from lightgbm_tpu.ops.overlap import overlap_chunks
+    out = {
+        "multichip_devices_visible": ndev,
+        "multichip_device_kind": jax.devices()[0].platform,
+        "multichip_rows": n, "multichip_iters": iters,
+        "multichip_leaves": leaves, "multichip_max_bin": max_bin,
+        "multichip_overlap_chunks": overlap_chunks(),
+    }
+    if dryrun:
+        out["multichip_dryrun"] = True
+
+    # 1-chip serial anchor: the PRODUCTION single-chip path (fused
+    # blocks) at the same shape — scaling efficiency is honest only
+    # against the path a 1-chip user actually runs
+    serial_rate, serial_auc, _ = synthetic_leg(n, iters, leaves, max_bin,
+                                               f=f, seed=0)
+    out["multichip_serial_row_iters_per_sec"] = round(serial_rate, 1)
+    out["multichip_serial_train_auc"] = round(serial_auc, 5)
+
+    # one shared binned dataset for every mesh run (binning the 1M-row
+    # store once, not per mesh size)
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] - X[:, 2]
+         + rng.normal(scale=1.0, size=n) > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": max_bin})
+    ds.construct()
+    del X
+
+    table = []
+    parity_ok = True
+    best_vs = 0.0
+    for d in [c for c in (2, 4, 8) if c <= ndev]:
+        if _budget_exceeded():
+            out.setdefault("multichip_skipped_counts", []).append(d)
+            continue
+        r_on, auc_on, ph_on, m_on = _mc_train_rate(
+            ds, y, n, iters, leaves, max_bin, d, overlap=True)
+        r_off, _, ph_off, m_off = _mc_train_rate(
+            ds, y, n, iters, leaves, max_bin, d, overlap=False)
+        parity_ok = parity_ok and (m_on == m_off)
+        vs = r_on / REFERENCE_ROW_ITERS_PER_SEC
+        best_vs = max(best_vs, vs)
+        table.append({
+            "devices": d,
+            "row_iters_per_sec": round(r_on, 1),
+            "no_overlap_row_iters_per_sec": round(r_off, 1),
+            "overlap_speedup": round(r_on / max(r_off, 1e-9), 4),
+            "scaling_efficiency": round(
+                r_on / max(d * serial_rate, 1e-9), 4),
+            "vs_baseline": round(vs, 4),
+            "train_auc": round(auc_on, 5),
+            "auc_ok": bool(auc_on >= AUC_GATE),
+            "warm_s": ph_on["warm_s"],
+            "steady_s": ph_on["steady_s"],
+        })
+        out["multichip_table"] = table
+        out["multichip_parity_ok"] = bool(parity_ok)
+        out["multichip_best_vs_baseline"] = round(best_vs, 4)
+        if line is not None:
+            line.update(out)
+            line["partial"] = f"multichip-{d}dev"
+            _emit(line)
+        gc.collect()
+    out["multichip_table"] = table
+    out["multichip_parity_ok"] = bool(parity_ok)
+    out["multichip_best_vs_baseline"] = round(best_vs, 4)
+
+    # the FULL 10.5M-row HIGGS-shape leg on the widest available mesh
+    # (the headline-scale claim; budget-guarded, TPU runs only)
+    if (not dryrun and os.environ.get("BENCH_MC_FULL", "1") != "0"
+            and not _budget_exceeded() and table):
+        d = table[-1]["devices"]
+        nf = int(os.environ.get("BENCH_MC_FULL_ROWS", 10_500_000))
+        itf = int(os.environ.get("BENCH_MC_FULL_ITERS", 64))
+        del ds
+        gc.collect()
+        rng = np.random.RandomState(1)
+        Xf = rng.normal(size=(nf, 28)).astype(np.float32)
+        yf = (Xf[:, 0] * 2 + Xf[:, 1] - Xf[:, 2]
+              + rng.normal(scale=1.0, size=nf) > 0).astype(np.float32)
+        dsf = lgb.Dataset(Xf, label=yf, params={"max_bin": max_bin})
+        dsf.construct()
+        del Xf
+        rf, aucf, phf, _ = _mc_train_rate(dsf, yf, nf, itf, leaves,
+                                          max_bin, d, overlap=True)
+        out.update({
+            "multichip_full_devices": d, "multichip_full_rows": nf,
+            "multichip_full_iters": itf,
+            "multichip_full_row_iters_per_sec": round(rf, 1),
+            "multichip_full_vs_baseline": round(
+                rf / REFERENCE_ROW_ITERS_PER_SEC, 4),
+            "multichip_full_train_auc": round(aucf, 5),
+            "multichip_full_warm_s": phf["warm_s"],
+            "multichip_full_steady_s": phf["steady_s"],
+        })
+        del dsf
+        gc.collect()
+    return out
+
+
+def multichip_child():
+    """``bench.py --multichip-child``: the dryrun mechanics run inside
+    the forced 2-device CPU pool (spawned by :func:`multichip_leg`)."""
+    out = multichip_leg(dryrun=True)
+    print("MULTICHIP_CHILD:" + json.dumps(out), flush=True)
+
+
+def _validate_north_star_aux(ns: dict):
+    """Validate the extended north_star.json tables: each aux wave key
+    is either a measured list of rows (positive ns/row) or a
+    pending-capture spec naming its shape; ``multichip`` likewise.
+    -> (ok, detail)"""
+    detail = {}
+    ok = True
+    for key in WAVE_AUX_SHAPES:
+        v = ns.get(key)
+        if isinstance(v, list):
+            good = bool(v) and all(
+                float(r.get("ns_per_row", r.get("wide_ns_per_row", 0))) > 0
+                for r in v)
+        elif isinstance(v, dict):
+            good = (v.get("status") == "pending-capture"
+                    and int(v.get("features", 0)) > 0
+                    and int(v.get("max_bin", 0)) > 0)
+        else:
+            good = False
+        detail[key] = "measured" if isinstance(v, list) else (
+            "pending-capture" if good else "invalid")
+        ok = ok and good
+    mc = ns.get("multichip")
+    if isinstance(mc, list):
+        good = bool(mc) and all(
+            int(r.get("devices", 0)) >= 2
+            and float(r.get("row_iters_per_sec", 0)) > 0 for r in mc)
+    elif isinstance(mc, dict):
+        good = mc.get("status") == "pending-capture"
+    else:
+        good = False
+    detail["multichip"] = "measured" if isinstance(mc, list) else (
+        "pending-capture" if good else "invalid")
+    return ok and good, detail
+
+
 def dryrun_main():
     """``bench.py --dryrun``: emit the per-bucket wave table at toy
     shape (CPU-safe, seconds) and cross-check that the committed
@@ -498,19 +816,54 @@ def dryrun_main():
     ns_path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
                            "tests", "data", "north_star.json")
     ns_ok, ns_buckets, err = True, [], None
+    aux_ok, aux_detail = False, {}
     try:
         with open(ns_path) as fh:
-            wk = json.load(fh)["wave_kernel"]
+            ns = json.load(fh)
+        wk = ns["wave_kernel"]
         ns_buckets = [int(r["active"]) for r in wk]
         ns_ok = bool(wk) and all(float(r["ns_per_row"]) > 0 for r in wk)
+        aux_ok, aux_detail = _validate_north_star_aux(ns)
     except Exception as exc:        # noqa: BLE001 - reported on the line
         ns_ok, err = False, f"{type(exc).__name__}: {exc}"
     line = {"metric": "wave_kernel_ns_per_row", "dryrun": True,
             "wave_kernel": table,
             "north_star_wave_buckets": ns_buckets,
-            "north_star_parse_ok": ns_ok}
+            "north_star_parse_ok": ns_ok,
+            "north_star_aux_ok": aux_ok,
+            "north_star_aux_detail": aux_detail}
     if err:
         line["north_star_parse_error"] = err
+    # 255-bin / MSLR-shape wave tables at toy interpret shape: the
+    # mechanics gate for the extended north_star.json tables
+    try:
+        line.update(wave_aux_tables(dryrun=True))
+        line["wave_aux_ok"] = all(
+            r["wide_ns_per_row"] > 0 for key in WAVE_AUX_SHAPES
+            for r in line[key])
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["wave_aux_ok"] = False
+        line["wave_aux_error"] = f"{type(exc).__name__}: {exc}"
+    # multichip mechanics gate: the REAL leg on a 2-device virtual CPU
+    # pool (re-exec'd child) — schema + overlap bit-parity validated as
+    # tier-1 (tests/test_bench_budget)
+    try:
+        mleg = multichip_leg(dryrun=True)
+        missing = [k for k in MULTICHIP_SCHEMA_KEYS if k not in mleg]
+        rows = mleg.get("multichip_table") or []
+        sane = (not missing and rows
+                and all(r["row_iters_per_sec"] > 0
+                        and r["no_overlap_row_iters_per_sec"] > 0
+                        and r["scaling_efficiency"] > 0 for r in rows)
+                and mleg["multichip_parity_ok"]
+                and mleg["multichip_serial_row_iters_per_sec"] > 0)
+        line.update(mleg)
+        line["multichip_schema_ok"] = bool(sane)
+        if missing:
+            line["multichip_schema_missing"] = missing
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["multichip_schema_ok"] = False
+        line["multichip_leg"] = f"failed: {type(exc).__name__}: {exc}"
     # serve (predict) leg schema gate: run the REAL leg at toy shape on
     # CPU and check every field the TPU run will record is present and
     # sane — the tier-1 mechanics gate for the predict-leg artifact
@@ -720,6 +1073,15 @@ def main():
             line["wave_kernel"] = waves
             line["partial"] = "headline-1M+waves"
             _emit(line)
+        # 255-bin / MSLR-shape tables (north_star.json wave_kernel_255 /
+        # wave_kernel_mslr): the losing-regime attribution data ROADMAP
+        # item 2 asks for, captured alongside the default-shape table
+        if os.environ.get("BENCH_WAVES_AUX", "1") != "0":
+            aux = _leg(line, "waves_aux", wave_aux_tables)
+            if aux is not None:
+                line.update(aux)
+                line["partial"] = "headline-1M+waves-aux"
+                _emit(line)
 
     if os.environ.get("BENCH_FULL", "1") != "0":
         n_full = int(os.environ.get("BENCH_FULL_ROWS", 10_500_000))
@@ -756,6 +1118,92 @@ def main():
         line["partial"] = "headline-full"
         _emit(line)
 
+    def _checkpoint(stage):
+        """Flush the line after EVERY aux leg (success, failure, or
+        skip): satellite of VERDICT r5 Weak #1/#3 — a driver deadline
+        mid-run must never erase a leg that already ran, including its
+        failure markers."""
+        line["partial"] = stage
+        _emit(line)
+
+    # Aux-leg ORDER (VERDICT r5 Weak #3): the never-captured /
+    # stale-captured numbers run FIRST so a driver deadline cannot
+    # starve them again — multichip (the >=2-chip north star; an
+    # instant "skipped: devices" marker on 1-chip images), then bin255
+    # (never produced a number), rank63, serve (PR 6 numbers never
+    # landed in an artifact), then the heavyweight 255-bin rank leg,
+    # and valid (repeatedly captured) last.
+
+    # multichip leg: data-parallel scaling across a real >=2-chip mesh
+    # with the overlapped reduction on/off (ROADMAP item 1).  Gate:
+    # overlap on/off models must be byte-identical when the leg RAN
+    # (a wrong-answer speedup must not score).
+    if os.environ.get("BENCH_MC", "1") != "0":
+        mleg = _leg(line, "multichip", lambda: multichip_leg(line),
+                    gate=True)
+        if mleg is not None:
+            line.update(mleg)
+            if not mleg.get("multichip_parity_ok", True):
+                auc_ok = False
+        _checkpoint("headline-full+multichip")
+
+    # 255-bin leg (VERDICT r4 #7): the EXACT docs/Experiments.rst:104-116
+    # bin/leaf config (max_bin=255, 255 leaves) at reduced iterations, so
+    # the CPU comparison has an apples-to-apples anchor (the 238.5 s CPU
+    # run was recorded at 255 bins; the 63-bin default above follows the
+    # reference GPU docs' own recommendation).  255 is also the boundary
+    # of the Pallas one-hot kernel's bin range — worth pinning.
+    if os.environ.get("BENCH_255", "1") != "0":
+        n255 = int(os.environ.get("BENCH_255_ROWS", 1_000_000))
+        it255 = int(os.environ.get("BENCH_255_ITERS", 32))
+        leg255 = _leg(line, "bin255", lambda: synthetic_leg(
+            n255, it255, leaves, 255, seed=2), gate=True)
+        if leg255 is not None:
+            rps_255, auc_255, ph_255 = leg255
+            auc_255_ok = bool(auc_255 >= AUC_GATE)
+            line.update({
+                "bin255_rows": n255, "bin255_iters": it255,
+                "bin255_row_iters_per_sec": round(rps_255, 1),
+                "bin255_train_auc": round(auc_255, 5),
+                "bin255_auc_ok": auc_255_ok,
+                "bin255_vs_baseline": round(
+                    rps_255 / REFERENCE_ROW_ITERS_PER_SEC, 4),
+                "bin255_compile_s": ph_255["compile_s"],
+                "bin255_steady_s": ph_255["steady_s"],
+            })
+            auc_ok = auc_ok and auc_255_ok
+        _checkpoint("aux-bin255")
+
+    # ranking legs: their own baseline (MS LTR) and their own NDCG gate
+    # — reported alongside, not folded into the HIGGS-headline min (the
+    # headline metric is specifically the HIGGS-shape row-iters rate).
+    # Gate policy: a leg that RUNS and fails its quality gate zeroes the
+    # headline; a leg that CRASHES twice is recorded in legs_failed /
+    # legs_ok=false instead — a transient tunnel fault must not erase
+    # the HIGGS number, and the failure stays loud in the artifact.
+    # rank63 (the GPU-docs-recommended 63-bin variant; their own MS-LTR
+    # runs hold NDCG parity at 63 bins) runs BEFORE the heavier
+    # config-exact 255-bin leg.
+    if os.environ.get("BENCH_RANK", "1") != "0":
+        # drop the binary legs' compiled programs + buffers before the
+        # wide-feature rank datasets allocate.  (Note: rank doc-rates
+        # legitimately fall with the iteration window — later
+        # iterations build deeper trees; the recorded *_iters says
+        # which window a number measures.)
+        import gc
+        import jax
+        gc.collect()
+        jax.clear_caches()
+        if os.environ.get("BENCH_RANK63", "1") != "0":
+            rank63 = _leg(line, "rank63", lambda: ranking_leg(
+                max_bin=63, iters_env="BENCH_RANK63_ITERS",
+                iters_default=32), gate=True)
+            if rank63 is not None:
+                line.update(rank63)
+                if not rank63["rank63_ndcg_ok"]:
+                    auc_ok = False
+            _checkpoint("aux-rank63")
+
     # serve (predict) leg: the inference workload (ROADMAP item 3) —
     # big-batch rows/s, the int8-binned fast path, per-bucket p50/p99
     # through the async harness, and the zero-recompile check.  Its
@@ -767,8 +1215,19 @@ def main():
             line.update(sleg)
             if not (sleg["serve_parity_ok"] and sleg["serve_recompile_ok"]):
                 auc_ok = False
-            line["partial"] = "headline-full+serve"
-            _emit(line)
+        _checkpoint("aux-serve")
+
+    if os.environ.get("BENCH_RANK", "1") != "0":
+        import gc
+        import jax
+        gc.collect()
+        jax.clear_caches()
+        rank = _leg(line, "rank", ranking_leg, gate=True)  # config-exact 255-bin
+        if rank is not None:
+            line.update(rank)
+            if not rank["rank_ndcg_ok"]:
+                auc_ok = False
+        _checkpoint("aux-rank")
 
     # with-valid leg (VERDICT r4 #1): the standard train+valid+early-stop
     # workflow must stay on the fused block path, within ~20% of the
@@ -796,65 +1255,6 @@ def main():
             if not vleg["valid_block_ok"]:
                 auc_ok = False
 
-    # 255-bin leg (VERDICT r4 #7): the EXACT docs/Experiments.rst:104-116
-    # bin/leaf config (max_bin=255, 255 leaves) at reduced iterations, so
-    # the CPU comparison has an apples-to-apples anchor (the 238.5 s CPU
-    # run was recorded at 255 bins; the 63-bin default above follows the
-    # reference GPU docs' own recommendation).  255 is also the boundary
-    # of the Pallas one-hot kernel's bin range — worth pinning.
-    if os.environ.get("BENCH_255", "1") != "0":
-        n255 = int(os.environ.get("BENCH_255_ROWS", 1_000_000))
-        it255 = int(os.environ.get("BENCH_255_ITERS", 32))
-        leg255 = _leg(line, "bin255", lambda: synthetic_leg(
-            n255, it255, leaves, 255, seed=2), gate=True)
-        if leg255 is not None:
-            rps_255, auc_255, ph_255 = leg255
-            auc_255_ok = bool(auc_255 >= AUC_GATE)
-            line.update({
-                "bin255_rows": n255, "bin255_iters": it255,
-                "bin255_row_iters_per_sec": round(rps_255, 1),
-                "bin255_train_auc": round(auc_255, 5),
-                "bin255_auc_ok": auc_255_ok,
-                "bin255_vs_baseline": round(
-                    rps_255 / REFERENCE_ROW_ITERS_PER_SEC, 4),
-                "bin255_compile_s": ph_255["compile_s"],
-                "bin255_steady_s": ph_255["steady_s"],
-            })
-            auc_ok = auc_ok and auc_255_ok
-
-    # ranking leg: its own baseline (MS LTR) and its own NDCG gate —
-    # reported alongside, not folded into the HIGGS-headline min (the
-    # headline metric is specifically the HIGGS-shape row-iters rate).
-    # Gate policy: a leg that RUNS and fails its quality gate zeroes the
-    # headline; a leg that CRASHES twice is recorded in legs_failed /
-    # legs_ok=false instead — a transient tunnel fault must not erase
-    # the HIGGS number, and the failure stays loud in the artifact.
-    if os.environ.get("BENCH_RANK", "1") != "0":
-        # drop the binary legs' compiled programs + buffers before the
-        # wide-feature rank datasets allocate.  (Note: rank doc-rates
-        # legitimately fall with the iteration window — later
-        # iterations build deeper trees; the recorded *_iters says
-        # which window a number measures.)
-        import gc
-        import jax
-        gc.collect()
-        jax.clear_caches()
-        rank = _leg(line, "rank", ranking_leg, gate=True)  # config-exact 255-bin
-        if rank is not None:
-            line.update(rank)
-            if not rank["rank_ndcg_ok"]:
-                auc_ok = False
-        # the GPU-docs-recommended 63-bin variant of the same workload
-        # (their own MS-LTR runs hold NDCG parity at 63 bins)
-        if os.environ.get("BENCH_RANK63", "1") != "0":
-            rank63 = _leg(line, "rank63", lambda: ranking_leg(
-                max_bin=63, iters_env="BENCH_RANK63_ITERS",
-                iters_default=32), gate=True)
-            if rank63 is not None:
-                line.update(rank63)
-                if not rank63["rank63_ndcg_ok"]:
-                    auc_ok = False
-
     if not auc_ok:
         vs = 0.0    # a bench run that failed to learn scores zero
     if line.get("legs_hard_failed"):
@@ -875,7 +1275,9 @@ def main():
 
 if __name__ == "__main__":
     import sys
-    if "--dryrun" in sys.argv:
+    if "--multichip-child" in sys.argv:
+        multichip_child()
+    elif "--dryrun" in sys.argv:
         dryrun_main()
     else:
         main()

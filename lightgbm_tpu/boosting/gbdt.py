@@ -41,6 +41,31 @@ from ..utils.log import log_info, log_warning
 K_MODEL_VERSION = "v2"     # reference gbdt_model_text.cpp:13
 
 
+def _donation_enabled() -> bool:
+    """Buffer donation through the jitted training programs (default
+    ON on accelerators): the fused block donates the running score
+    state (train + valid) so XLA writes the updated scores in place
+    instead of allocating a second [n, K] f32 set per dispatch, and
+    the mesh build donates grad/hess.  At the 10.5M-row HIGGS shape
+    that is ~120 MB of HBM churn per block removed — headroom the
+    wave histograms and the serve pack share.  ``LGBM_TPU_DONATE=0``
+    disables for A/B (and restores full mid-execution retryability of
+    the dispatch retry).
+
+    CPU is excluded unconditionally: on the CPU backend ``np.asarray``
+    of a device array is a ZERO-COPY view into the XLA buffer, and
+    jaxlib 0.4.x donation reuses/frees that same memory — host reads
+    of the score state (eval metrics, feval, the C API) then race the
+    donated dispatch and flakily SIGSEGV (reproduced in this image:
+    ``binary_auc`` reading a just-returned valid-score view crashed
+    in 3/4 tier-1 runs with donation on, 0/4 with it off).  On
+    TPU/GPU every host read is a device→host copy, so donation is
+    safe there — and that is where the HBM win lives."""
+    if jax.default_backend() == "cpu":
+        return False
+    return _os.environ.get("LGBM_TPU_DONATE", "1") != "0"
+
+
 _EFFORT_OPT_OK: Optional[bool] = None
 
 
@@ -383,22 +408,66 @@ class GBDT:
                         hist_mode=hist_mode,
                         split_kernel=not split_kernel_disabled())
         else:
+            from ..ops.overlap import overlap_enabled
             from ..parallel.learners import build_tree_distributed
+            from jax.sharding import NamedSharding, PartitionSpec as P
             mesh = self.mesh_ctx.mesh
             axis = self.mesh_ctx.data_axis
             lt, tk = c.tree_learner, c.top_k
             dist_hist_mode = c.hist_mode or None
             self._bins_t = None
+            # overlap resolved ONCE per program build (not at trace
+            # time): an env flip mid-run must not serve a stale trace
+            # from the per-instance jit cache
+            overlap = overlap_enabled()
+            row_sharded = lt in ("data", "voting")
+            if self._pr is None:
+                # place the dataset ONCE under explicit sharding rules
+                # (bins row-sharded / replicated per learner type,
+                # metadata replicated): every per-iteration dispatch
+                # then consumes it in place instead of re-laying-out
+                # the store to the mesh (the multi-process path is
+                # already placed via make_array_from_process_local_data)
+                self.device_data = self.mesh_ctx.place_data(
+                    self.device_data, row_sharded=row_sharded)
+            pad = self._row_pad
+            row_ns = NamedSharding(mesh, P(axis) if row_sharded else P())
 
             def _raw_build(dd, grad, hess, bag, fmask, bins_t=None):
+                # row padding + placement INSIDE the jitted program:
+                # the old eager per-iteration jnp.concatenate calls
+                # were 3 extra host-driven dispatches per tree, each
+                # re-placing its output from the default device
+                if bag is None:
+                    bag = jnp.ones(grad.shape[0], bool)
+                if pad:
+                    grad = jnp.concatenate(
+                        [grad, jnp.zeros(pad, grad.dtype)])
+                    hess = jnp.concatenate(
+                        [hess, jnp.zeros(pad, hess.dtype)])
+                    bag = jnp.concatenate([bag, jnp.zeros(pad, bool)])
+                grad = jax.lax.with_sharding_constraint(grad, row_ns)
+                hess = jax.lax.with_sharding_constraint(hess, row_ns)
+                bag = jax.lax.with_sharding_constraint(bag, row_ns)
                 return build_tree_distributed(
                     mesh, axis, lt, dd, grad, hess, growth,
                     bag_mask=bag, feature_mask=fmask, top_k=tk,
-                    hist_mode=dist_hist_mode)
+                    hist_mode=dist_hist_mode, overlap=overlap)
         # serial path: already jitted at module level (shared cache);
-        # mesh path: per-instance jit (mesh/axis closed over)
-        self._jit_build = (_raw_build if self.mesh_ctx is None
-                           else jax.jit(_raw_build))
+        # mesh path: per-instance jit (mesh/axis closed over), with
+        # grad/hess donated — they die with the build (every caller
+        # hands in per-iteration slices), freeing 2 x [n_pad] f32 of
+        # HBM for the wave histograms.  Donation is safe with the
+        # dispatch retry: the transient class it covers surfaces at
+        # compile/enqueue time, before execution consumes the buffers
+        # (LGBM_TPU_DONATE=0 restores undonated dispatches for A/B;
+        # CPU never donates — see _donation_enabled).
+        if self.mesh_ctx is None:
+            self._jit_build = _raw_build
+        elif _donation_enabled():
+            self._jit_build = jax.jit(_raw_build, donate_argnums=(1, 2))
+        else:
+            self._jit_build = jax.jit(_raw_build)
         self._block_fns: Dict[int, object] = {}
         self._block_len_uses: Dict[int, int] = {}
         self._block_compiling: set = set()
@@ -664,15 +733,11 @@ class GBDT:
                     fmask = pr.replicate(np.asarray(fmask))
                 return self._jit_build(self.device_data, grad, hess, bag,
                                        fmask)
-            pad = self._row_pad
-            if bag is None:
-                bag = jnp.ones(n, bool)
-            if pad:
-                grad = jnp.concatenate([grad, jnp.zeros(pad, grad.dtype)])
-                hess = jnp.concatenate([hess, jnp.zeros(pad, hess.dtype)])
-                bag = jnp.concatenate([bag, jnp.zeros(pad, bool)])
+            # padding + mesh placement of grad/hess/bag happen INSIDE
+            # the jitted program (_raw_build) — one dispatch, no eager
+            # per-iteration concat round-trips
             bt = self._jit_build(self.device_data, grad, hess, bag, fmask)
-            if pad:
+            if self._row_pad:
                 bt = bt._replace(row_leaf=bt.row_leaf[:n])
             return bt
         try:
@@ -1042,13 +1107,25 @@ class GBDT:
                                 it0 + jnp.arange(cap))
 
         from ..learner.serial import _COMPILE_LEAN_ROWS
+        jit_kw = {}
+        if _donation_enabled():
+            # donate the running score state (train scores + valid
+            # scores): the block returns their successors with
+            # identical shape/dtype, so XLA aliases the buffers and
+            # updates in place — no second [n, K] (+ valid) f32 live
+            # set per dispatch.  Safe with _dispatch_retry: its
+            # transient class surfaces at compile/enqueue, before
+            # execution consumes the inputs; and safe with the
+            # split-kernel fallback redispatch, which only ever fires
+            # on a COMPILE failure (buffers untouched).
+            jit_kw["donate_argnums"] = (3, 4)
         if n <= _COMPILE_LEAN_ROWS and _effort_opt_supported():
             # small data: XLA compile time dominates the cold start and
             # runtime barely responds to optimization effort — measured
             # 6.2 s -> 3.0 s compile with identical ms/iter at 7k rows
             return jax.jit(block, compiler_options={
-                "exec_time_optimization_effort": -1.0})
-        return jax.jit(block)
+                "exec_time_optimization_effort": -1.0}, **jit_kw)
+        return jax.jit(block, **jit_kw)
 
     def _spawn_block_compile(self, L: int) -> None:
         """AOT-compile the length-``L`` block program on a background

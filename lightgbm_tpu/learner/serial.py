@@ -414,19 +414,32 @@ def make_fused_fn(data: DeviceData, grad, hess, hist_mode: str,
 def make_serial_strategy(data: DeviceData, grad, hess, params: GrowthParams,
                          feature_mask, psum_fn=None, backend: str = "auto",
                          hist_mode: Optional[str] = None,
-                         bins_t: Optional[jnp.ndarray] = None):
+                         bins_t: Optional[jnp.ndarray] = None,
+                         psum_axis: Optional[str] = None):
     """The serial (and data-parallel, via `psum_fn`) wave strategy:
     histogram the active leaves, subtract siblings, rescan changed leaves.
 
     `psum_fn` injects the data-parallel histogram collective — the
     reference's ReduceScatter seam (`data_parallel_tree_learner.cpp:147-162`)
-    collapses to one psum of the active-leaf histograms."""
+    collapses to one psum of the active-leaf histograms.  `psum_axis`
+    switches that collective to the OVERLAPPED lowering
+    (`ops/overlap.py`): the same logical reduction issued as column
+    chunks whose sibling-subtract/state-scatter consumers double-buffer
+    against the chunks still in flight — bit-identical values, identical
+    logical schedule."""
     L = params.num_leaves
     hist_fn = make_hist_fn(data, grad, hess, L, backend, hist_mode, bins_t)
 
     def wave(hist_state, hist_leaf, act_small, act_parent, act_sibling,
              lsg, lsh, lc):
         new_h = hist_fn(hist_leaf, act_small)            # [A, G, Bg, 3]
+        if psum_axis is not None:
+            from ..ops.overlap import reduce_apply_overlapped
+            hist_state, ids, grid = reduce_apply_overlapped(
+                hist_state, new_h, act_small, act_parent, act_sibling, L,
+                psum_axis)
+            return scan_grid(data, params, feature_mask, hist_state, ids,
+                             grid, lsg, lsh, lc)
         if psum_fn is not None:
             new_h = psum_fn(new_h)
         return rescan_changed(data, params, feature_mask, hist_state, new_h,
@@ -444,6 +457,16 @@ def rescan_changed(data: DeviceData, params: GrowthParams, feature_mask,
     L = hist_state.shape[0]
     hist_state, ids, grid = apply_hist_wave(
         hist_state, new_h, act_small, act_parent, act_sibling, L)
+    return scan_grid(data, params, feature_mask, hist_state, ids, grid,
+                     lsg, lsh, lc)
+
+
+def scan_grid(data: DeviceData, params: GrowthParams, feature_mask,
+              hist_state, ids, grid, lsg, lsh, lc):
+    """EFB unbundle + best-split rescan of the changed-leaf grids — the
+    tail of :func:`rescan_changed`, split out so the overlapped wave
+    (`ops/overlap.py` reduce+apply) can share it verbatim."""
+    L = hist_state.shape[0]
     safe = jnp.clip(ids, 0, L - 1)
     if data.is_bundled:
         from ..ops.histogram import unbundle_grid
@@ -485,14 +508,17 @@ def build_tree(data: DeviceData,
                hist_backend: str = "auto",
                num_hist_features: Optional[int] = None,
                bins_t: Optional[jnp.ndarray] = None,
-               hist_mode: Optional[str] = None) -> BuiltTree:
+               hist_mode: Optional[str] = None,
+               psum_axis: Optional[str] = None) -> BuiltTree:
     """Grow one tree.  Jittable; `psum_fn` lets the data-parallel learner
     inject a collective over active-leaf histograms; `strategy` replaces
     the whole wave procedure (feature/voting-parallel,
     `parallel/learners.py`).  `num_hist_features` overrides the width of
     the histogram state (feature-parallel shards keep only their slice);
     `bins_t` is the once-per-dataset transposed bins (computed here when
-    absent)."""
+    absent); `psum_axis` routes the data-parallel wave reduction through
+    the overlapped chunked lowering (`ops/overlap.py`) — `psum_fn` is
+    still used for the root-statistics reduction either way."""
     n = data.bins.shape[0]
     L = params.num_leaves
 
@@ -538,7 +564,8 @@ def build_tree(data: DeviceData,
         strategy = make_serial_strategy(data, grad, hess, params,
                                         feature_mask, psum_fn=psum_fn,
                                         backend=backend, bins_t=bins_t,
-                                        hist_mode=hist_mode)
+                                        hist_mode=hist_mode,
+                                        psum_axis=psum_axis)
     route_fn = make_route_fn(data, backend, bins_t)
 
     def scan_changed(hist_state, new_h, s, lsg, lsh, lc):

@@ -315,14 +315,26 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
                            bag_mask=None, feature_mask=None,
                            top_k: int = 20,
                            hist_backend: str = "auto",
-                           hist_mode=None) -> BuiltTree:
+                           hist_mode=None,
+                           overlap: Optional[bool] = None) -> BuiltTree:
     """Run one tree build as an SPMD program over `mesh`.
 
     Row-sharded inputs (data/voting): ``bins``, ``grad``, ``hess``,
     ``bag_mask`` are sharded on the leading axis; tree outputs are
     replicated; ``row_leaf`` stays sharded.  Feature-parallel replicates
     rows and slices features inside the shard.
+
+    ``overlap`` (data-parallel only; default = ``LGBM_TPU_OVERLAP``,
+    on): lower the per-wave histogram psum through the double-buffered
+    chunked reduction (`ops/overlap.py`) — bit-identical trees, the
+    identical logical collective schedule (same flight-recorder
+    fingerprints), with the reduction tail hidden behind the per-chunk
+    sibling-subtract/state-scatter.  The root-statistics psum and the
+    feature/voting collectives are untouched either way.
     """
+    from ..ops.overlap import overlap_enabled
+    if overlap is None:
+        overlap = overlap_enabled()
     num_shards = mesh.shape[axis]
     row_shard = learner_type in ("data", "voting")
     n = data.num_data
@@ -343,9 +355,12 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
         data_l = DeviceData(bins, offs, nb, db, mt, ic, nanb, fg, fo,
                             *statics)
         nhf = None
+        psum_axis = None
         if learner_type == "data":
             strategy = None        # serial strategy + histogram psum
             psum_fn = _psum(axis)
+            if overlap:
+                psum_axis = axis   # overlapped wave reduction
         elif learner_type == "feature":
             strategy, nhf = make_feature_parallel_strategy(
                 data_l, grad_l, hess_l, params, fmask_l, axis, num_shards,
@@ -361,7 +376,8 @@ def build_tree_distributed(mesh: Mesh, axis: str, learner_type: str,
         return build_tree(data_l, grad_l, hess_l, params, bag_mask=bag_l,
                           feature_mask=fmask_l, strategy=strategy,
                           psum_fn=psum_fn, hist_backend=hist_backend,
-                          num_hist_features=nhf, hist_mode=hist_mode)
+                          num_hist_features=nhf, hist_mode=hist_mode,
+                          psum_axis=psum_axis)
 
     out_spec = BuiltTree(
         feature=P(), threshold_bin=P(), default_left=P(), is_categorical=P(),

@@ -78,11 +78,11 @@ def test_deadline_skips_aux_legs_with_markers(bench_run):
     final = _parse_lines(bench_run.stdout)[-1]
     assert "partial" not in final           # the complete line
     assert final["value"] > 0               # headline retained
-    for leg in ("serve", "valid", "bin255", "rank", "rank63"):
+    for leg in ("serve", "valid", "bin255", "rank", "rank63", "multichip"):
         assert final.get(f"{leg}_leg") == "skipped: budget", final
     assert final.get("real_data") == "skipped: budget"
     assert set(final.get("legs_skipped", [])) >= {
-        "serve", "valid", "bin255", "rank", "rank63"}
+        "serve", "valid", "bin255", "rank", "rank63", "multichip"}
     # an explicit skip is not a failure: no legs_failed / hard-failed
     assert "legs_failed" not in final
     assert "legs_hard_failed" not in final
@@ -130,6 +130,32 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     assert out["serve_requests"] > 0
     for rec in out["serve_latency_ms"].values():
         assert rec["count"] > 0 and rec["p99"] >= rec["p50"] >= 0.0
+    # multichip mechanics gate (PR 7): the REAL leg ran on a 2-device
+    # virtual CPU pool (re-exec'd child) — schema complete, both
+    # overlap modes measured, and the overlap on/off models
+    # byte-identical (the serial-psum-schedule bit-parity contract)
+    from bench import MULTICHIP_SCHEMA_KEYS
+    assert out["multichip_schema_ok"] is True, out.get(
+        "multichip_leg", out.get("multichip_schema_missing"))
+    for key in MULTICHIP_SCHEMA_KEYS:
+        assert key in out, key
+    assert out["multichip_devices_visible"] >= 2
+    assert out["multichip_parity_ok"] is True
+    assert out["multichip_serial_row_iters_per_sec"] > 0
+    for row in out["multichip_table"]:
+        assert row["devices"] >= 2
+        assert row["row_iters_per_sec"] > 0
+        assert row["no_overlap_row_iters_per_sec"] > 0
+        assert row["scaling_efficiency"] > 0
+        assert row["overlap_speedup"] > 0
+    # extended north_star tables (255-bin / MSLR / multichip): either
+    # measured rows or an explicit pending-capture spec — and the toy
+    # aux wave tables actually ran
+    assert out["north_star_aux_ok"] is True, out.get(
+        "north_star_aux_detail")
+    assert out["wave_aux_ok"] is True, out.get("wave_aux_error")
+    for key in ("wave_kernel_255", "wave_kernel_mslr"):
+        assert all(r["wide_ns_per_row"] > 0 for r in out[key]), out[key]
 
 
 def test_north_star_wave_entries_parse():
