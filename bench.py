@@ -101,6 +101,21 @@ def _emit(line) -> None:
     print(json.dumps(line), flush=True)
 
 
+def _peak_field(line, prefix=None) -> None:
+    """Record the per-leg ``peak_hbm_bytes`` field (ISSUE 8: BENCH
+    artifacts carry memory alongside throughput).  The value is the
+    process-cumulative device HBM peak at leg completion
+    (``device.memory_stats()``); on backends without allocator stats
+    (the CPU tier-1 runs) it is null and ``peak_hbm_reason`` says why
+    — an explicit marker, never a silent absence."""
+    from lightgbm_tpu.obs.mem_contract import peak_hbm_bytes
+    peak, reason = peak_hbm_bytes()
+    key = f"{prefix}_peak_hbm_bytes" if prefix else "peak_hbm_bytes"
+    line[key] = peak
+    if peak is None and reason:
+        line.setdefault("peak_hbm_reason", reason)
+
+
 def _auc(y, s):
     from lightgbm_tpu.metric.metrics import binary_auc
     return binary_auc(y, s)
@@ -881,6 +896,19 @@ def dryrun_main():
     except Exception as exc:        # noqa: BLE001 - reported on the line
         line["serve_schema_ok"] = False
         line["serve_leg"] = f"failed: {type(exc).__name__}: {exc}"
+    # per-leg peak_hbm_bytes (ISSUE 8): every leg the dryrun emitted
+    # carries the field — a positive int where the backend exposes
+    # allocator stats, null + peak_hbm_reason where it doesn't (CPU) —
+    # validated as peak_hbm_schema_ok (tier-1, tests/test_bench_budget)
+    for prefix in (None, "waves", "multichip", "serve"):
+        _peak_field(line, prefix)
+    peak_keys = ("peak_hbm_bytes", "waves_peak_hbm_bytes",
+                 "multichip_peak_hbm_bytes", "serve_peak_hbm_bytes")
+    line["peak_hbm_schema_ok"] = all(
+        k in line and (
+            (isinstance(line[k], int) and line[k] > 0)
+            or (line[k] is None and bool(line.get("peak_hbm_reason"))))
+        for k in peak_keys)
     _emit(line)
 
 
@@ -1005,7 +1033,9 @@ def _leg(line, name, fn, retries=1, gate=False):
         try:
             if os.environ.get("BENCH_FORCE_FAIL") == name:
                 raise RuntimeError("forced failure (BENCH_FORCE_FAIL)")
-            return fn()
+            out = fn()
+            _peak_field(line, name)
+            return out
         except Exception as exc:
             # keep only the STRING: the exception's traceback pins the
             # failed attempt's frames (and their multi-GB leg buffers)
@@ -1015,6 +1045,7 @@ def _leg(line, name, fn, retries=1, gate=False):
             del exc
             gc.collect()
     line[f"{name}_leg"] = f"failed: {errs[-1]}"
+    _peak_field(line, name)         # the leg RAN: its peak still counts
     line.setdefault("legs_failed", []).append(name)
     if gate and len(set(errs)) == 1:
         line.setdefault("legs_hard_failed", []).append(name)
@@ -1039,6 +1070,8 @@ def main():
     else:
         try:
             real = real_data_eval()
+            if "unavailable" not in str(real.get("real_data", "")):
+                _peak_field(real, "real_data")
         except Exception as exc:  # real-data leg must never kill the bench
             real = {"real_data": f"failed: {exc}"}
 
@@ -1059,6 +1092,7 @@ def main():
     # headline checkpoint: from here on a driver timeout can no longer
     # erase the 1M leg (the driver takes the LAST parseable line)
     line["vs_baseline"] = round(vs if auc_ok else 0.0, 4)
+    _peak_field(line)               # headline leg's device HBM peak
     line["partial"] = "headline-1M"
     _emit(line)
 

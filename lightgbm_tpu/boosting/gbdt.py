@@ -40,6 +40,15 @@ from ..utils.log import log_info, log_warning
 
 K_MODEL_VERSION = "v2"     # reference gbdt_model_text.cpp:13
 
+# mem.leak fault sink (tests/test_mem_contract.py): while the fault
+# point is armed, _train appends one fresh device array per window
+# here — a module-lifetime live-buffer leak the HBM watermark contract
+# (obs/mem_contract.py, LGBM_TPU_MEM_CONTRACT=1) must catch and name.
+_MEM_LEAK_SINK: List[jnp.ndarray] = []
+# bytes leaked per window ~= 4 * this (f32); > the contract's default
+# 1 MiB tolerance so a single armed window is visible above it
+_MEM_LEAK_ELEMS = int(_os.environ.get("LGBM_TPU_MEM_LEAK_ELEMS", 1 << 19))
+
 
 def _donation_enabled() -> bool:
     """Buffer donation through the jitted training programs (default
@@ -468,6 +477,11 @@ class GBDT:
             self._jit_build = jax.jit(_raw_build, donate_argnums=(1, 2))
         else:
             self._jit_build = jax.jit(_raw_build)
+        # recorded for the HBM watermark contract's donation-
+        # effectiveness probe (obs/mem_contract.py): only meaningful on
+        # backends where the score-state donation is actually armed
+        self._donate_active = _donation_enabled()
+        self._mem_watermark = None
         self._block_fns: Dict[int, object] = {}
         self._block_len_uses: Dict[int, int] = {}
         self._block_compiling: set = set()
@@ -1358,13 +1372,17 @@ class GBDT:
         cache — the report lands in the telemetry summary's
         ``trace_contract`` section (background block-length upgrades
         are counted separately, not as violations)."""
+        from ..obs.mem_contract import maybe_watermark
         from ..obs.trace_contract import maybe_track
-        with obs_span("gbdt.train"), maybe_track() as tracker:
+        with obs_span("gbdt.train"), maybe_track() as tracker, \
+                maybe_watermark("gbdt") as wm:
             self._trace_tracker = tracker
+            self._mem_watermark = wm
             try:
                 self._train(num_iterations, callbacks)
             finally:
                 self._trace_tracker = None
+                self._mem_watermark = None
         from ..obs import enabled as obs_enabled, gauge_set
         if obs_enabled():
             gauge_set("gbdt.iterations", int(self.iter))
@@ -1425,6 +1443,24 @@ class GBDT:
             tracker = getattr(self, "_trace_tracker", None)
             if tracker is not None:
                 tracker.mark_steady()
+            # mem.leak fault: grow a module-lifetime sink by one fresh
+            # device buffer per window (the leak class the watermark
+            # contract catches; it != 0 defeats constant folding)
+            from ..utils.faults import fault_flag
+            if fault_flag("mem.leak"):
+                # memcheck: disable=MEM005 -- intentional fault-
+                # injection leak sink, armed only by chaos/tier-1 tests
+                _MEM_LEAK_SINK.append(
+                    jnp.full((_MEM_LEAK_ELEMS,), float(it), jnp.float32))
+            wm = getattr(self, "_mem_watermark", None)
+            if wm is not None:
+                # one sample per window boundary: the leak gate
+                wm.sample("gbdt.window", it=int(it))
+                if self._donate_active:
+                    # donation-effectiveness: the in-place score update
+                    # must keep exactly ONE live [n, K] f32 set
+                    wm.check_donation(self.scores.shape,
+                                      self.scores.dtype, expected=1)
             if stop:
                 break
             if want_eval and eval_freq > 0 and it % eval_freq == 0:

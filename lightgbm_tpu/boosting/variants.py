@@ -197,6 +197,9 @@ class GOSS(GBDT):
                 self._goss_mp_sample = jax.jit(
                     lambda G, H, it, valid, orig_idx: self._block_sample(
                         G, H, it, valid, orig_idx))
+            # memcheck: disable=MEM002 -- per-iteration [n] f32 pair, not
+            # persistent state; this path runs in tier-1 on the CPU
+            # backend where donation is gated off (zero-copy host reads)
             grad, hess, bag = self._goss_mp_sample(
                 grad, hess, jnp.int32(self.iter), self._goss_valid,
                 self._goss_orig)

@@ -790,6 +790,9 @@ def make_phases_driver(data: DeviceData,
                 hist_state, ids, res = scan_jit(state, new_h, feature_mask)
                 done(res.gain)
             with obs_span("tree.update"), tag("tree:update") as done:
+                # memcheck: disable=MEM002 -- wave-loop carry on the
+                # unfused profiling path; production training rides the
+                # fused block whose score state IS donated (gated)
                 state = update_jit(state, leaf2, hist_state, ids, res)
                 done(state.nl)
             if bool(state.done) or int(state.nl) >= L:

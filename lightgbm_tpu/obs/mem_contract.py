@@ -1,0 +1,245 @@
+"""Runtime HBM watermark contract — the memory half of memcheck.
+
+The static analyzer (``tools/memcheck``) pins donation/footprint
+hazards at the source level; this module is the runtime wall,
+mirroring the trace-contract/flight-recorder pattern: under
+``LGBM_TPU_MEM_CONTRACT=1`` the training loop samples device memory
+once per window (and the serving harness once per batch) and enforces
+two properties over the steady state:
+
+* **leak gate** — once warmup is over (the first sampled window: block
+  compiles and first-touch allocations land there), the sampled live
+  bytes may not grow beyond ``baseline + tolerance``.  The comparison
+  is against the steady-state BASELINE, not the previous sample, so a
+  slow per-window creep (the classic "list appending device arrays"
+  leak) accumulates into a violation instead of hiding under a
+  per-step tolerance.  Tolerance: ``LGBM_TPU_MEM_TOL_BYTES`` (default
+  1 MiB) + ``LGBM_TPU_MEM_TOL_FRAC`` (default 0.02) x baseline.
+* **donation effectiveness** — when buffer donation is on (TPU/GPU;
+  ``gbdt._donation_enabled``), the in-place score update must be
+  observed working: at most ONE live device buffer with the score
+  state's (shape, dtype) may exist at a window boundary.  A second
+  live score set means XLA stopped aliasing the donated buffer (a
+  silent 2x HBM regression at the 10.5M-row shape).
+
+Violations emit a ``mem:watermark_violation`` telemetry event NAMING
+THE SPAN that crossed the watermark, and the full report lands in the
+run summary as the ``mem_contract`` / ``serve_mem_contract`` section
+(the same surface the trace contract uses), so BENCH artifacts and
+merged multi-host summaries carry it.
+
+Sampling sources, best effort in order:
+
+1. ``device.memory_stats()`` — real allocator numbers
+   (``bytes_in_use`` / ``peak_bytes_in_use``) on TPU/GPU;
+2. ``jax.live_arrays()`` — the sum of live buffer ``nbytes`` in this
+   process.  The CPU backend returns no ``memory_stats``; live-array
+   accounting keeps the leak gate meaningful there (tier-1 proves the
+   contract on CPU), at the cost of not seeing allocator slack.
+
+``peak_hbm_bytes()`` is the bench hook: the process-cumulative device
+peak for the artifact's per-leg ``peak_hbm_bytes`` field, or
+``(None, reason)`` on backends without allocator stats.
+"""
+from __future__ import annotations
+
+import os
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+__all__ = [
+    "enabled", "device_memory_sample", "peak_hbm_bytes", "Watermark",
+    "maybe_watermark",
+]
+
+
+def enabled() -> bool:
+    return os.environ.get("LGBM_TPU_MEM_CONTRACT", "") == "1"
+
+
+def _tol_bytes() -> int:
+    return int(os.environ.get("LGBM_TPU_MEM_TOL_BYTES", 1 << 20))
+
+
+def _tol_frac() -> float:
+    return float(os.environ.get("LGBM_TPU_MEM_TOL_FRAC", 0.02))
+
+
+def device_memory_sample() -> Tuple[int, Optional[int], str]:
+    """-> (live_bytes, peak_bytes_or_None, source).  Never raises."""
+    import jax
+    try:
+        stats = jax.devices()[0].memory_stats()
+    # tpulint: disable=TPL006 -- best-effort probe; absence IS the signal
+    except Exception:
+        stats = None
+    if stats:
+        return (int(stats.get("bytes_in_use", 0)),
+                int(stats.get("peak_bytes_in_use", 0)) or None,
+                "memory_stats")
+    try:
+        live = jax.live_arrays()
+        total = 0
+        for a in live:
+            nb = getattr(a, "nbytes", None)
+            if nb is not None:
+                total += int(nb)
+        return total, None, "live_arrays"
+    # tpulint: disable=TPL006 -- best-effort probe; absence IS the signal
+    except Exception:
+        return 0, None, "unavailable"
+
+
+def peak_hbm_bytes() -> Tuple[Optional[int], Optional[str]]:
+    """Process-cumulative device HBM peak for bench artifacts:
+    (bytes, None) when the backend exposes allocator stats, else
+    (None, reason)."""
+    import jax
+    try:
+        d = jax.devices()[0]
+        stats = d.memory_stats()
+    # tpulint: disable=TPL006 -- best-effort probe; absence IS the signal
+    except Exception as exc:
+        return None, f"memory_stats probe failed: {type(exc).__name__}"
+    if not stats:
+        return None, (f"memory_stats unavailable on the "
+                      f"{d.platform} backend")
+    peak = stats.get("peak_bytes_in_use")
+    if peak is None:
+        return None, "allocator reports no peak_bytes_in_use"
+    return int(peak), None
+
+
+def count_live_like(shape, dtype) -> int:
+    """Live device buffers matching (shape, dtype) in this process —
+    the donation-effectiveness probe (an aliased in-place score update
+    keeps exactly one)."""
+    import jax
+    try:
+        live = jax.live_arrays()
+    # tpulint: disable=TPL006 -- best-effort probe; absence IS the signal
+    except Exception:
+        return -1
+    n = 0
+    for a in live:
+        if getattr(a, "shape", None) == tuple(shape) \
+                and getattr(a, "dtype", None) == dtype:
+            n += 1
+    return n
+
+
+class Watermark:
+    """Per-run watermark state: call :meth:`sample` at every window/
+    batch boundary, :meth:`finalize` once at the end (writes the
+    summary section).  ``sampler`` is injectable for unit tests."""
+
+    def __init__(self, kind: str, warmup: int = 1,
+                 sampler: Callable[[], Tuple[int, Optional[int], str]]
+                 = device_memory_sample):
+        self.kind = kind
+        self.warmup = max(0, int(warmup))
+        self._sampler = sampler
+        self.samples: List[Dict[str, Any]] = []
+        self.violations: List[Dict[str, Any]] = []
+        self.baseline: Optional[int] = None
+        self.source = "unsampled"
+        self.max_bytes = 0
+        self.peak_bytes: Optional[int] = None
+        self.donation_checked = False
+        self.donation_ok = True
+
+    def sample(self, span: str, **attrs) -> None:
+        live, peak, source = self._sampler()
+        self.source = source
+        self.max_bytes = max(self.max_bytes, live)
+        if peak is not None:
+            self.peak_bytes = peak
+        idx = len(self.samples)
+        rec = {"span": span, "bytes": int(live), "idx": idx}
+        rec.update(attrs)
+        self.samples.append(rec)
+        if source == "unavailable":
+            return
+        if idx < self.warmup:
+            return
+        if self.baseline is None:
+            self.baseline = int(live)
+            return
+        tol = _tol_bytes() + int(_tol_frac() * self.baseline)
+        if live > self.baseline + tol:
+            grew = int(live - self.baseline)
+            self.violations.append(
+                {"span": span, "grew_bytes": grew, "bytes": int(live),
+                 "baseline": self.baseline, "tol_bytes": tol, "idx": idx})
+            from . import event
+            event("mem", "watermark_violation", contract=self.kind,
+                  span=span, grew_bytes=grew, baseline=self.baseline,
+                  tol_bytes=tol)
+            from ..utils.log import log_warning
+            log_warning(
+                f"mem contract violated in {self.kind}: live bytes grew "
+                f"{grew} over the steady baseline {self.baseline} "
+                f"(tol {tol}) at span {span!r} — a per-window leak")
+
+    def check_donation(self, shape, dtype, expected: int = 1) -> None:
+        """Donation-effectiveness probe (call when donation is ON):
+        more than ``expected`` live (shape, dtype) buffers at a window
+        boundary means the in-place update stopped aliasing."""
+        n = count_live_like(shape, dtype)
+        if n < 0:
+            return
+        self.donation_checked = True
+        if n > expected:
+            self.donation_ok = False
+            self.violations.append(
+                {"span": f"{self.kind}.donation", "live_score_buffers": n,
+                 "expected": expected})
+            from . import event
+            event("mem", "watermark_violation", contract=self.kind,
+                  span=f"{self.kind}.donation", live_score_buffers=n,
+                  expected=expected)
+
+    def report(self) -> Dict[str, Any]:
+        return {
+            "kind": self.kind,
+            "source": self.source,
+            "windows_sampled": len(self.samples),
+            "baseline_bytes": self.baseline,
+            "max_bytes": self.max_bytes,
+            "peak_bytes": self.peak_bytes,
+            "tol_bytes": (_tol_bytes()
+                          + int(_tol_frac() * (self.baseline or 0))),
+            "violations": self.violations[:16],
+            "violation_count": len(self.violations),
+            "donation_checked": self.donation_checked,
+            "donation_ok": self.donation_ok,
+            "steady_ok": not self.violations,
+        }
+
+    def finalize(self, section: Optional[str] = None) -> Dict[str, Any]:
+        rep = self.report()
+        from . import set_section
+        set_section(section or "mem_contract", rep)
+        return rep
+
+
+class maybe_watermark:
+    """``with maybe_watermark("gbdt") as wm:`` — a live
+    :class:`Watermark` under ``LGBM_TPU_MEM_CONTRACT=1`` (section
+    written on exit), else None at ~zero cost."""
+
+    def __init__(self, kind: str, section: Optional[str] = None,
+                 warmup: int = 1):
+        self.kind = kind
+        self.section = section
+        self.warmup = warmup
+        self.wm: Optional[Watermark] = None
+
+    def __enter__(self) -> Optional[Watermark]:
+        if enabled():
+            self.wm = Watermark(self.kind, warmup=self.warmup)
+        return self.wm
+
+    def __exit__(self, *exc) -> bool:
+        if self.wm is not None:
+            self.wm.finalize(self.section)
+        return False

@@ -88,15 +88,14 @@ def compact_slot_threshold() -> int:
 
 
 def compact_config_ok(max_bins: int, mode: str) -> bool:
-    """VMEM feasibility of the grouped kernel: the per-grid-cell model
-    of the wide kernel (`pallas_histogram._cell_vmem_bytes`) extended
-    to the compacted cell — same resident arrays at the group column
-    count, plus the (negligible) [G, 1] group-active slice and [1, T]
-    compacted leaf row, at the 1024-row fallback tile."""
-    B = bin_stride(max_bins)
-    C, _, cols = _col_layout(COMPACT_GROUP, mode)
+    """VMEM feasibility of the grouped kernel: the shared per-grid-cell
+    model (`ops/vmem.hist_cell_ok`) at the compacted cell — same
+    resident arrays at the group column count, plus the (negligible)
+    [G, 1] group-active slice and [1, T] compacted leaf row, at the
+    1024-row fallback tile."""
+    from .vmem import hist_cell_ok
     extra = COMPACT_GROUP * 4 + 2 * 1024 * 4   # group actives + leaf row
-    return _cell_vmem_bytes(8, B, cols, 1024, C) + extra <= _VMEM_BUDGET_BYTES
+    return hist_cell_ok(max_bins, COMPACT_GROUP, mode, extra_bytes=extra)
 
 
 def compact_plan(hist_leaf: jnp.ndarray, active: jnp.ndarray,

@@ -65,6 +65,17 @@ import jax.numpy as jnp
 from jax.experimental import pallas as pl
 from jax.experimental.pallas import tpu as pltpu
 
+# the VMEM feasibility model (budget, per-grid-cell arithmetic, tile
+# caps) is SHARED across every Pallas kernel — ops/vmem.py is its home
+# (and what tools/memcheck's MEM004 keys on); the old underscore names
+# stay bound here for the kernels and tests that grew up on them
+from .vmem import (VMEM_BUDGET_BYTES as _VMEM_BUDGET_BYTES,
+                   cell_vmem_bytes as _cell_vmem_bytes,
+                   feat_tile_cap as _feat_tile_cap, hist_cell_ok,
+                   next_pow2 as _next_pow2,
+                   pick_row_tile as _pick_row_tile,
+                   round_up as _round_up)
+
 LANE = 128
 # rows per kernel grid step; env-tunable for A/B perf work.  2048 beats
 # 1024 by ~5% on the bench (fewer grid steps to amortize per-tile fixed
@@ -73,51 +84,6 @@ LANE = 128
 # two tile <= it divides n_pad; pallas_route imports it for the same
 # reason.
 DEFAULT_ROW_TILE = int(os.environ.get("LGBM_TPU_ROW_TILE", 2048))
-# per-grid-cell VMEM budget for the histogram kernel's resident arrays
-# (f32 accumulator + bf16 one-hot + bins tile + value columns).  Sized
-# to what the previous spread-matmul kernel demonstrably ran on the v5e
-# (larger footprints compiled and executed); the streamed inputs'
-# double-buffering is counted inside _cell_vmem_bytes.
-_VMEM_BUDGET_BYTES = 12 * 1024 * 1024
-
-
-def _cell_vmem_bytes(ft: int, B: int, cols: int, T: int, C: int) -> int:
-    """VMEM footprint of one (feature-tile, row-tile) grid cell: the f32
-    accumulator, the bf16 one-hot, the weighted value block, the bins
-    tile (double-buffered), and the packed values."""
-    return (ft * B * cols * 4        # accumulator (out block)
-            + ft * B * T * 2         # one-hot bf16
-            + T * cols * 2           # vw bf16
-            + 2 * ft * T             # bins tile, double-buffered
-            + 2 * T * C * 4)         # vals, double-buffered
-
-
-def _feat_tile_cap(B: int, cols: int, T: int, C: int) -> int:
-    """Largest feature tile whose grid cell fits the VMEM budget."""
-    ft = max(1, _VMEM_BUDGET_BYTES // (B * (cols * 4 + T * 2)))
-    while ft > 1 and _cell_vmem_bytes(ft, B, cols, T, C) > _VMEM_BUDGET_BYTES:
-        ft -= 1
-    return ft
-
-
-def _pick_row_tile(n_pad: int, B: int, cols: int, C: int,
-                   requested: int) -> int:
-    """Largest power-of-two tile <= `requested` that divides ``n_pad``
-    and whose minimum-feature-tile grid cell fits the VMEM budget."""
-    T = requested
-    while T > 1024 and (
-            n_pad % T != 0
-            or _cell_vmem_bytes(8, B, cols, T, C) > _VMEM_BUDGET_BYTES):
-        T //= 2
-    return T
-
-
-def _next_pow2(x: int) -> int:
-    return 1 << max(0, (x - 1).bit_length())
-
-
-def _round_up(x: int, m: int) -> int:
-    return -(-x // m) * m
 
 
 def split_hi_lo(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
@@ -139,19 +105,9 @@ def split_hi_lo(x: jnp.ndarray) -> tuple[jnp.ndarray, jnp.ndarray]:
     return hi, x - hi
 
 
-def bin_stride(max_bins: int) -> int:
-    """Per-feature bin stride used by the kernel's joint index space."""
-    return max(8, _next_pow2(max_bins))
-
-
-def _col_layout(A: int, mode: str) -> tuple[int, int, int]:
-    """-> (C, A_pad, cols): value columns, padded active slots, lane-
-    aligned total output columns."""
-    C = {"hilo": 5, "ghilo": 4, "hhilo": 4, "int8h": 4,
-         "int8hh": 5}.get(mode, 3)
-    A_pad = _round_up(A, 8)
-    cols = _round_up(C * A_pad, LANE)
-    return C, A_pad, cols
+# layout arithmetic shared with the VMEM model (ops/vmem.py owns it so
+# the feasibility predicates and the kernels can never disagree on it)
+from .vmem import bin_stride, col_layout as _col_layout  # noqa: E402
 
 
 def is_quantized(mode: str) -> bool:
@@ -172,14 +128,12 @@ def pallas_config_ok(max_bins: int, num_leaves: int, mode: str) -> bool:
     # (ops/pallas_route.py); past ~1024 leaves it no longer fits
     if num_leaves > 1024:
         return False
-    B = bin_stride(max_bins)
     # the staged wave plan (learner/serial.py stage_plan) caps active
-    # slots at 128 regardless of num_leaves
-    C, _, cols = _col_layout(min(max(1, num_leaves // 2), 128), mode)
-    # the minimum feature tile of 8 must fit the full VMEM model at the
-    # 1024-row fallback tile (_pick_row_tile halves down to it) —
-    # ADVICE r2: the accumulator alone under-counts
-    return _cell_vmem_bytes(8, B, cols, 1024, C) <= _VMEM_BUDGET_BYTES
+    # slots at 128 regardless of num_leaves; the minimum feature tile
+    # of 8 must fit the full VMEM model at the 1024-row fallback tile
+    # (_pick_row_tile halves down to it) — ADVICE r2: the accumulator
+    # alone under-counts
+    return hist_cell_ok(max_bins, min(max(1, num_leaves // 2), 128), mode)
 
 
 def transpose_bins(bins: jnp.ndarray, row_tile: int = DEFAULT_ROW_TILE,

@@ -77,8 +77,10 @@ _WORKING_SET_BYTES_PER_CELL = 72
 
 
 def _vmem_budget_bytes() -> int:
-    return int(float(os.environ.get("LGBM_TPU_SPLIT_VMEM_MB", 12))
-               * (1 << 20))
+    # the shared VMEM model (ops/vmem.py) owns the knob so memcheck's
+    # MEM004 and this kernel agree on where feasibility is decided
+    from .vmem import split_vmem_budget_bytes
+    return split_vmem_budget_bytes()
 
 
 # module-global kill switch: flipped by disable_on_compile_error when a

@@ -1,0 +1,124 @@
+"""Shared VMEM feasibility model for every Pallas kernel in the repo.
+
+Through PR 5 each kernel carried its own copy of the same question —
+"does this config's per-grid-cell working set fit the ~16 MB/core VMEM
+with headroom?" — as ``pallas_histogram._cell_vmem_bytes`` /
+``_feat_tile_cap``, ``compact.compact_config_ok``, and the split
+kernel's ``_vmem_budget_bytes`` leaf-tile chooser.  PR 1's ADVICE-r5
+fix (the pallas_split lane cap blowing VMEM and surfacing as a Mosaic
+crash instead of a fallback) showed what happens when a kernel ships
+WITHOUT the model.  This module is the single home for that
+arithmetic, pure int math with **no jax import**, so:
+
+* every kernel dispatcher keys its config gate on one budget
+  (``VMEM_BUDGET_BYTES``, measured headroom under the v5e's ~16 MB/core
+  — see the provenance note below), and
+* the memcheck static analyzer (``tools/memcheck``, rule MEM004) can
+  enforce "no ``pallas_call`` without a VMEM-model predicate" by
+  KEYING ON THIS MODULE: ``VMEM_GUARDS`` below names the sanctioned
+  predicates; any module that dispatches a Pallas kernel must reference
+  one of them (or any ``*vmem*`` helper) on its guard path.
+
+Budget provenance: 12 MiB per grid cell.  The previous spread-matmul
+kernel demonstrably ran larger footprints on the v5e, so 12 MiB under
+the ~16 MB/core ceiling leaves room for the streamed inputs'
+double-buffering (counted inside :func:`cell_vmem_bytes`) plus Mosaic's
+own scratch.  The split kernel's budget is the same default, overridable
+for hardware-verified tuning via ``LGBM_TPU_SPLIT_VMEM_MB``.
+"""
+from __future__ import annotations
+
+import os
+
+LANE = 128
+
+# per-grid-cell VMEM budget for the histogram-family kernels' resident
+# arrays (f32 accumulator + bf16 one-hot + bins tile + value columns)
+VMEM_BUDGET_BYTES = 12 * 1024 * 1024
+
+# The sanctioned VMEM-guard predicate names: tools/memcheck rule MEM004
+# parses this tuple (statically — no import) and requires every module
+# with a `pallas_call` to reference one of these names, or any name
+# containing "vmem", on its dispatch path.  Extend this tuple when a
+# new kernel family grows its own predicate.
+VMEM_GUARDS = (
+    "pallas_config_ok",      # wide one-hot histogram + route table model
+    "fused_config_ok",       # fused route+hist kernel
+    "compact_config_ok",     # leaf-compacted deep-wave kernel
+    "hist_cell_ok",          # the generic predicate below
+)
+
+
+def next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+def round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def bin_stride(max_bins: int) -> int:
+    """Per-feature bin stride used by the kernels' joint index space."""
+    return max(8, next_pow2(max_bins))
+
+
+def col_layout(A: int, mode: str) -> tuple[int, int, int]:
+    """-> (C, A_pad, cols): value columns, padded active slots, lane-
+    aligned total output columns."""
+    C = {"hilo": 5, "ghilo": 4, "hhilo": 4, "int8h": 4,
+         "int8hh": 5}.get(mode, 3)
+    A_pad = round_up(A, 8)
+    cols = round_up(C * A_pad, LANE)
+    return C, A_pad, cols
+
+
+def cell_vmem_bytes(ft: int, B: int, cols: int, T: int, C: int) -> int:
+    """VMEM footprint of one (feature-tile, row-tile) histogram grid
+    cell: the f32 accumulator, the bf16 one-hot, the weighted value
+    block, the bins tile (double-buffered), and the packed values."""
+    return (ft * B * cols * 4        # accumulator (out block)
+            + ft * B * T * 2         # one-hot bf16
+            + T * cols * 2           # vw bf16
+            + 2 * ft * T             # bins tile, double-buffered
+            + 2 * T * C * 4)         # vals, double-buffered
+
+
+def feat_tile_cap(B: int, cols: int, T: int, C: int) -> int:
+    """Largest feature tile whose grid cell fits the VMEM budget."""
+    ft = max(1, VMEM_BUDGET_BYTES // (B * (cols * 4 + T * 2)))
+    while ft > 1 and cell_vmem_bytes(ft, B, cols, T, C) > VMEM_BUDGET_BYTES:
+        ft -= 1
+    return ft
+
+
+def pick_row_tile(n_pad: int, B: int, cols: int, C: int,
+                  requested: int) -> int:
+    """Largest power-of-two tile <= ``requested`` that divides ``n_pad``
+    and whose minimum-feature-tile grid cell fits the VMEM budget."""
+    T = requested
+    while T > 1024 and (
+            n_pad % T != 0
+            or cell_vmem_bytes(8, B, cols, T, C) > VMEM_BUDGET_BYTES):
+        T //= 2
+    return T
+
+
+def hist_cell_ok(max_bins: int, active_slots: int, mode: str,
+                 row_tile: int = 1024, extra_bytes: int = 0) -> bool:
+    """The generic histogram-kernel feasibility predicate: does the
+    minimum-feature-tile grid cell at ``active_slots`` output slots fit
+    the budget (at the 1024-row fallback tile ``pick_row_tile`` halves
+    down to)?  ``extra_bytes`` covers kernel-specific residents (the
+    compacted kernel's group-active slice + leaf row)."""
+    B = bin_stride(max_bins)
+    C, _, cols = col_layout(active_slots, mode)
+    return (cell_vmem_bytes(8, B, cols, row_tile, C) + extra_bytes
+            <= VMEM_BUDGET_BYTES)
+
+
+def split_vmem_budget_bytes() -> int:
+    """Working-set budget for the fused split kernel's leaf-tile choice
+    (env-tunable: the split kernel holds ~6 concurrent [3*Lc, FB] f32
+    arrays in its missing path — see ops/pallas_split.py)."""
+    return int(float(os.environ.get("LGBM_TPU_SPLIT_VMEM_MB", 12))
+               * (1 << 20))

@@ -263,3 +263,172 @@ def assert_envelope(serial, dist, bins: np.ndarray,
             + "\nlast recorded collective schedule (flight recorder):\n"
             + ("\n".join(lines) if lines else "  <empty>"))
     return rep
+
+
+# ----------------------------------------------------------------------
+# Model-level flip envelope: block-vs-eager training paths.
+#
+# The fused lax.scan block and the eager per-iteration path run the same
+# math through DIFFERENT XLA programs, so f32 scatter-add reassociation
+# makes histogram sums (and therefore recorded gains and leaf values)
+# drift in the last ulp from the very first tree.  Most of the time that
+# drift is invisible; occasionally it flips a near-tie split winner or a
+# missing-direction choice, after which the two models fit different
+# residuals and every later tree legitimately diverges.  The tree-level
+# near_tie_report above can't gate this axis (it needs row_leaf vectors
+# of a single tree pair); this section classifies the divergence at the
+# MODEL-TEXT level instead: the structural prefix must match exactly,
+# the first flip must be a genuine near-tie, and nothing past the flip
+# is compared (incomparable by construction).
+
+def _parse_model_trees(model_str: str):
+    """Parse the reference text format into per-tree numpy arrays."""
+    trees, cur = [], None
+    for line in model_str.splitlines():
+        if line.startswith("Tree="):
+            cur = {}
+            trees.append(cur)
+        elif line.startswith("end of trees"):
+            cur = None
+        elif cur is not None and "=" in line:
+            k, v = line.split("=", 1)
+            cur[k] = v
+    out = []
+    for t in trees:
+        d: Dict[str, Any] = {"num_leaves": int(t.get("num_leaves", "1"))}
+        for k, dt in (("split_feature", np.int64),
+                      ("decision_type", np.int64),
+                      ("left_child", np.int64), ("right_child", np.int64),
+                      ("split_gain", np.float64), ("threshold", np.float64),
+                      ("leaf_value", np.float64)):
+            v = t.get(k, "").split()
+            d[k] = (np.asarray(v, dtype=dt) if v
+                    else np.zeros(0, dtype=dt))
+        out.append(d)
+    return out
+
+
+def model_flip_report(model_a: str, model_b: str,
+                      rel_margin: float = 0.05,
+                      abs_margin: float = 0.5) -> Dict[str, Any]:
+    """Compare two trained models (text format) tree by tree in boosting
+    order and classify the FIRST structural divergence.
+
+    Node numbering follows split order, so two trees that made the same
+    choices have identical (feature, threshold, decision_type, children)
+    arrays; thresholds come from the shared f64 bin uppers and compare
+    exactly.  The first differing node is the flip point — its two
+    recorded gains are the winning gains of two candidates over (modulo
+    f32 reassociation) the same histogram, so a legitimate flip requires
+    them to be nearly equal, exactly the near-tie argument
+    :func:`near_tie_report` makes per row.  Kinds:
+
+    * ``near_tie_flip`` — different split content at the flip node;
+      near-tie iff the gain gap is inside ``rel_margin`` OR
+      ``abs_margin`` (violating BOTH = corruption, same calibration as
+      :func:`assert_envelope`);
+    * ``missing_direction`` — same feature+threshold, only the
+      default-direction bit differs (the missing-side allocation was the
+      tie); gains are the same split's and must agree within margins;
+    * ``budget_flip`` — equal common prefix but one tree recorded more
+      splits (min_data/min_gain boundary); near-tie iff the extra gain
+      is small vs the tree's max gain or under ``abs_margin``.
+
+    Identical-prefix trees also contribute ``max_leaf_value_gap`` (the
+    f32 value envelope; the tree-level gate measured 0.0104 serial-side).
+    """
+    ta, tb = _parse_model_trees(model_a), _parse_model_trees(model_b)
+    report: Dict[str, Any] = {
+        "trees": int(min(len(ta), len(tb))),
+        "prefix_trees": 0, "flip_tree": None, "flip_node": None,
+        "flip_kind": None, "gain_a": None, "gain_b": None,
+        "rel_gain_gap": None, "abs_gain_gap": None, "near_tie": True,
+        "max_leaf_value_gap": 0.0,
+    }
+
+    def _near(ga: float, gb: float) -> bool:
+        gap = abs(ga - gb)
+        return (gap / max(abs(ga), abs(gb), 1e-12) <= rel_margin
+                or gap <= abs_margin)
+
+    for i, (x, y) in enumerate(zip(ta, tb)):
+        m = min(len(x["split_feature"]), len(y["split_feature"]))
+        neq = np.zeros(m, dtype=bool)
+        for k in ("split_feature", "threshold", "decision_type",
+                  "left_child", "right_child"):
+            neq |= x[k][:m] != y[k][:m]
+        diff = np.nonzero(neq)[0]
+        if not len(diff) and (len(x["split_feature"])
+                              == len(y["split_feature"])):
+            if len(x["leaf_value"]) == len(y["leaf_value"]) and m >= 0:
+                gap = (float(np.max(np.abs(x["leaf_value"]
+                                           - y["leaf_value"])))
+                       if len(x["leaf_value"]) else 0.0)
+                report["max_leaf_value_gap"] = max(
+                    report["max_leaf_value_gap"], gap)
+            report["prefix_trees"] = i + 1
+            continue
+        report["flip_tree"] = i
+        if len(diff):
+            j = int(diff[0])
+            ga = float(x["split_gain"][j])
+            gb = float(y["split_gain"][j])
+            same_split = (x["split_feature"][j] == y["split_feature"][j]
+                          and x["threshold"][j] == y["threshold"][j])
+            report["flip_kind"] = ("missing_direction" if same_split
+                                   else "near_tie_flip")
+        else:
+            # equal prefix, one tree kept splitting: judge the first
+            # extra split's gain against the tree's own scale
+            j = m
+            longer = x if len(x["split_feature"]) > m else y
+            ga = float(longer["split_gain"][m])
+            gb = 0.0
+            scale = float(np.max(longer["split_gain"])) if m else ga
+            report["flip_kind"] = "budget_flip"
+            report.update(flip_node=j, gain_a=ga, gain_b=gb,
+                          abs_gain_gap=ga,
+                          rel_gain_gap=ga / max(scale, 1e-12),
+                          near_tie=(ga <= abs_margin
+                                    or ga / max(scale, 1e-12)
+                                    <= rel_margin))
+            break
+        gap = abs(ga - gb)
+        report.update(flip_node=j, gain_a=ga, gain_b=gb,
+                      abs_gain_gap=gap,
+                      rel_gain_gap=gap / max(abs(ga), abs(gb), 1e-12),
+                      near_tie=_near(ga, gb))
+        break
+    return report
+
+
+def assert_model_flip_envelope(model_a: str, model_b: str,
+                               rel_margin: float = 0.05,
+                               abs_margin: float = 0.5,
+                               value_margin: float = 0.05,
+                               label: str = "block-vs-eager"
+                               ) -> Dict[str, Any]:
+    """Gate the model-level flip envelope; raises on a non-near-tie flip
+    or a prefix leaf-value gap outside the f32 envelope.  Returns the
+    report (``flip_tree`` None when the models match structurally)."""
+    rep = model_flip_report(model_a, model_b,
+                            rel_margin=rel_margin, abs_margin=abs_margin)
+    problems = []
+    if rep["flip_tree"] is not None and not rep["near_tie"]:
+        problems.append(
+            f"first structural divergence (tree {rep['flip_tree']}, node "
+            f"{rep['flip_node']}, kind {rep['flip_kind']}) is NOT a "
+            f"near-tie: gains=({rep['gain_a']:.6f}, {rep['gain_b']:.6f}) "
+            f"rel_gap={rep['rel_gain_gap']:.3e} "
+            f"abs_gap={rep['abs_gain_gap']:.3e} — this is not f32 "
+            f"reassociation noise; suspect a mask or histogram bug")
+    if rep["max_leaf_value_gap"] > value_margin:
+        problems.append(
+            f"identical-structure trees have leaf-value gap "
+            f"{rep['max_leaf_value_gap']:.3e} > {value_margin}: same "
+            f"regions, different values — the histogram sums diverged")
+    if problems:
+        raise AssertionError(
+            f"model flip envelope violated ({label}):\n- "
+            + "\n- ".join(problems) + f"\nreport: {rep}")
+    return rep

@@ -114,6 +114,13 @@ class PredictionServer:
         self._tracker: Optional[CompileTracker] = None
         if contract_enabled():
             self._tracker = CompileTracker(track_threads=False).__enter__()
+        # HBM watermark contract (obs/mem_contract.py): sampled once
+        # per coalesced batch on the worker thread; the report lands as
+        # the `serve_mem_contract` summary section on close().  Warmup
+        # 2: the first batches still materialize bucket result buffers.
+        from ..obs.mem_contract import maybe_watermark
+        self._mem_wm = maybe_watermark("serve", "serve_mem_contract",
+                                       warmup=2).__enter__()
         if warmup:
             self.warm()
         if self._tracker is not None:
@@ -151,6 +158,14 @@ class PredictionServer:
                     f"({', '.join(rep['steady_names'][:5])}) — a batch "
                     f"shape escaped the padding buckets")
             self._tracker = None
+        if self._mem_wm is not None:
+            rep = self._mem_wm.finalize("serve_mem_contract")
+            self._mem_wm = None
+            if not rep["steady_ok"]:
+                log_warning(
+                    f"serve mem contract violated: "
+                    f"{rep['violation_count']} watermark crossing(s) — "
+                    f"a per-batch live-buffer leak in the serving path")
         log_info(f"serve: drained ({self._n_resolved} resolved, "
                  f"{self._n_failed} failed, {self._n_batches} batches)")
 
@@ -279,6 +294,10 @@ class PredictionServer:
         counter_add("serve.batches")
         counter_add("serve.rows_batched", n)
         counter_add("serve.padded_rows", bucket - n)
+        if self._mem_wm is not None:
+            # per-batch watermark sample (worker thread — the Watermark
+            # appends under no lock, but only this thread samples it)
+            self._mem_wm.sample("serve.batch", bucket=bucket)
         off = 0
         for r in batch:
             k = r.rows.shape[0]

@@ -20,7 +20,13 @@ the seams where production faults actually strike:
 * ``serve.score``    — the serving harness's batched device dispatch
   (``serve/server.py``: a TPU worker restart mid-batch); retried by the
   shared policy, and the delivery contract (exactly-once per request)
-  must hold across the retry.
+  must hold across the retry,
+* ``mem.leak``       — a SILENT fault (queried via :func:`fault_flag`,
+  it never raises): while armed, the training loop appends one fresh
+  device array per window into a module-lifetime sink
+  (``boosting/gbdt.py``), simulating the live-buffer leak class the
+  ``LGBM_TPU_MEM_CONTRACT=1`` watermark gate
+  (``obs/mem_contract.py``) exists to catch.
 
 Each point is a single ``fault_point(name)`` call that is a no-op unless
 armed.  Tests arm points programmatically (:func:`inject`, or the
@@ -45,7 +51,7 @@ import threading
 from typing import Dict, Optional
 
 POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
-          "loader.read", "spmd.skip_record", "serve.score")
+          "loader.read", "spmd.skip_record", "serve.score", "mem.leak")
 
 
 class FaultInjected(RuntimeError):
@@ -155,6 +161,18 @@ def fault_point(name: str) -> None:
     counter_add(f"faults.{name}.fired")
     event("fault", name, transient=transient)
     raise FaultInjected(name, transient=transient)
+
+
+def fault_flag(name: str) -> bool:
+    """Non-raising variant of :func:`fault_point` for faults modeled as
+    silent MISBEHAVIOR rather than errors (``mem.leak``): True when the
+    armed point fires (consuming one shot, same counters/telemetry),
+    False otherwise."""
+    try:
+        fault_point(name)
+    except FaultInjected:
+        return True
+    return False
 
 
 class injected:

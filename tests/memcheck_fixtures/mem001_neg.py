@@ -1,0 +1,29 @@
+"""MEM001 negative: the sanctioned idiom — donation behind a backend
+gate (`_donation_enabled`-style predicate), host reads stay legal."""
+import jax
+import numpy as np
+
+
+def _donation_enabled():
+    return jax.default_backend() != "cpu"
+
+
+def build(fn):
+    if _donation_enabled():
+        step = jax.jit(fn, donate_argnums=(0,))
+    else:
+        step = jax.jit(fn)
+    return step
+
+
+def build_kw(fn):
+    jit_kw = {}
+    if _donation_enabled():
+        jit_kw["donate_argnums"] = (0,)
+    return jax.jit(fn, **jit_kw)
+
+
+def train(scores, fn):
+    step = build(fn)
+    scores = step(scores)
+    return np.asarray(scores)

@@ -1,0 +1,23 @@
+"""MEM001 positive: host reads of names an UNGATED donate_argnums jit
+may have consumed — the PR 7 CPU zero-copy SIGSEGV pattern."""
+import jax
+import numpy as np
+
+_block = jax.jit(lambda s: s * 2.0, donate_argnums=(0,))
+
+
+def train_step(scores):
+    scores_new = _block(scores)
+    host = np.asarray(scores)  # EXPECT: MEM001
+    return scores_new, host
+
+
+def peek(scores):
+    _block(scores)
+    return scores.item()  # EXPECT: MEM001
+
+
+def immediate(grad):
+    out = jax.jit(lambda g: g + 1.0, donate_argnums=(0,))(grad)
+    view = memoryview(grad)  # EXPECT: MEM001
+    return out, view

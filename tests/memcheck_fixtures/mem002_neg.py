@@ -1,0 +1,13 @@
+"""MEM002 negative: a donating binding (even gated elsewhere) or a
+fresh-name result is not a missed in-place update."""
+import jax
+
+step = jax.jit(lambda s: s + 1.0, donate_argnums=(0,))
+probe = jax.jit(lambda s: s.sum())
+
+
+def loop(state):
+    for _ in range(8):
+        state = step(state)          # donated: updates in place
+    total = probe(state)             # fresh name: no second state copy
+    return state, total

@@ -1,0 +1,17 @@
+"""MEM002 positive: persistent state threaded in-and-out of a jit with
+no donation path — every dispatch keeps two live copies."""
+import jax
+
+step = jax.jit(lambda s: s + 1.0)
+
+
+@jax.jit
+def advance(state):
+    return state * 0.5
+
+
+def loop(state):
+    for _ in range(8):
+        state = step(state)  # EXPECT: MEM002
+    state = advance(state)  # EXPECT: MEM002
+    return state

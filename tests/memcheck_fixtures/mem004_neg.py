@@ -1,0 +1,17 @@
+"""MEM004 negative: the dispatch path keys its config gate on the
+shared VMEM model (lightgbm_tpu/ops/vmem.py VMEM_GUARDS)."""
+import jax
+from jax.experimental import pallas as pl
+
+from lightgbm_tpu.ops.vmem import hist_cell_ok
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def dispatch(x, max_bins):
+    if not hist_cell_ok(max_bins, 32, "hilo"):
+        raise ValueError("config exceeds the VMEM cell budget")
+    return pl.pallas_call(
+        _kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)

@@ -1,0 +1,14 @@
+"""MEM004 positive: a pallas_call dispatched with no VMEM-model guard
+anywhere on its path — infeasible configs crash in Mosaic instead of
+falling back."""
+import jax
+from jax.experimental import pallas as pl
+
+
+def _kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * 2.0
+
+
+def dispatch(x):
+    return pl.pallas_call(  # EXPECT: MEM004
+        _kernel, out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)

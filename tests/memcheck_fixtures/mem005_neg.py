@@ -1,0 +1,12 @@
+"""MEM005 negative: function-scope arrays die with the call; literal
+appends can't pin device buffers."""
+import jax.numpy as jnp
+
+_NAMES = []
+_SHAPE = (128, 128)
+
+
+def make(x):
+    scratch = jnp.zeros(_SHAPE)
+    _NAMES.append("label")
+    return scratch + x

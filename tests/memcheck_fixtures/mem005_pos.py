@@ -1,0 +1,12 @@
+"""MEM005 positive: device buffers pinned for the process lifetime —
+a module-scope array and an unbounded module-container append."""
+import jax.numpy as jnp
+
+_RESIDENT = jnp.zeros((128, 128))  # EXPECT: MEM005
+_CACHE = []
+
+
+def accumulate(x):
+    y = jnp.sum(x * _RESIDENT)
+    _CACHE.append(y)  # EXPECT: MEM005
+    return y

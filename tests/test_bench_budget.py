@@ -74,6 +74,19 @@ def test_headline_line_survives_simulated_timeout(bench_run):
     assert first.get("partial") == "headline-1M"
 
 
+def test_headline_carries_peak_hbm_field(bench_run):
+    """ISSUE 8: every emitted leg carries ``peak_hbm_bytes`` — a
+    positive int where the backend exposes allocator stats, or null
+    with an explicit ``peak_hbm_reason`` (the CPU tier-1 case)."""
+    for line in _parse_lines(bench_run.stdout):
+        assert "peak_hbm_bytes" in line, line.get("partial", "final")
+        peak = line["peak_hbm_bytes"]
+        if peak is None:
+            assert line.get("peak_hbm_reason"), line
+        else:
+            assert isinstance(peak, int) and peak > 0
+
+
 def test_deadline_skips_aux_legs_with_markers(bench_run):
     final = _parse_lines(bench_run.stdout)[-1]
     assert "partial" not in final           # the complete line
@@ -156,6 +169,16 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     assert out["wave_aux_ok"] is True, out.get("wave_aux_error")
     for key in ("wave_kernel_255", "wave_kernel_mslr"):
         assert all(r["wide_ns_per_row"] > 0 for r in out[key]), out[key]
+    # per-leg memory column (ISSUE 8): every dryrun leg carries
+    # peak_hbm_bytes — int > 0 with allocator stats, else null + reason
+    assert out["peak_hbm_schema_ok"] is True, out
+    for key in ("peak_hbm_bytes", "waves_peak_hbm_bytes",
+                "multichip_peak_hbm_bytes", "serve_peak_hbm_bytes"):
+        assert key in out, key
+        if out[key] is None:
+            assert out.get("peak_hbm_reason"), out
+        else:
+            assert out[key] > 0
 
 
 def test_north_star_wave_entries_parse():

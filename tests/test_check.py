@@ -1,0 +1,73 @@
+"""Tier-1 gate: the umbrella static-analysis CLI (``python -m
+tools.check``) — all three analyzers over one shared AST parse.
+
+Replaces the per-analyzer clean-CLI tests (tpulint/spmdcheck each used
+to spawn their own subprocess): one subprocess now proves all three
+package gates exit clean, and the combined wall-clock is asserted
+against the sum of the individual CLIs plus 3 s — the shared-parse
+contract stated in ISSUE 8 (an umbrella that re-parsed per analyzer
+would blow this budget as the package grows).
+"""
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _timed_cli(module):
+    t0 = time.perf_counter()
+    proc = subprocess.run(
+        [sys.executable, "-m", module, "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    return proc, time.perf_counter() - t0
+
+
+def test_umbrella_clean_within_combined_budget():
+    """`python -m tools.check` exits 0 on the package (all three gates
+    clean vs their EMPTY baselines) in <= tpulint + spmdcheck CLI time
+    + 3 s (memcheck rides the shared parse almost for free)."""
+    tpl, t_tpl = _timed_cli("tools.tpulint")
+    spm, t_spm = _timed_cli("tools.spmdcheck")
+    assert tpl.returncode == 0, tpl.stdout + tpl.stderr
+    assert spm.returncode == 0, spm.stdout + spm.stderr
+
+    chk, t_chk = _timed_cli("tools.check")
+    assert chk.returncode == 0, chk.stdout + chk.stderr
+    for name in ("tpulint", "spmdcheck", "memcheck"):
+        assert f"{name}: clean" in chk.stdout, chk.stdout
+    assert t_chk <= t_tpl + t_spm + 3.0, (
+        f"umbrella {t_chk:.2f}s > tpulint {t_tpl:.2f}s + spmdcheck "
+        f"{t_spm:.2f}s + 3s: the shared-parse contract regressed")
+
+
+def test_umbrella_fails_on_seeded_hazard(tmp_path):
+    """One seeded hazard in any analyzer's domain flips the combined
+    gate red with the rule id."""
+    import shutil
+    pkg = tmp_path / "lightgbm_tpu"
+    shutil.copytree(os.path.join(REPO, "lightgbm_tpu"), pkg,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    target = pkg / "models" / "tree.py"
+    target.write_text(target.read_text() + (
+        "\n\nimport jax as _chk_probe_jax\n\n\n"
+        "@_chk_probe_jax.jit\n"
+        "def _check_probe(x):\n"
+        "    return x.sum().item()\n"))
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.check", "--root", str(tmp_path),
+         "--no-project-rules", "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True, timeout=300)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "TPL001" in proc.stdout, proc.stdout
+
+
+def test_in_process_cache_shares_one_run():
+    """The three gate tests share one analysis: a second cached_run_all
+    for the same root returns the SAME object, not a re-run."""
+    from tools.check import cached_run_all
+    a = cached_run_all(REPO)
+    b = cached_run_all(REPO)
+    assert a is b
+    assert set(a) == {"tpulint", "spmdcheck", "memcheck"}

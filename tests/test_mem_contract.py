@@ -1,0 +1,152 @@
+"""Runtime HBM watermark contract (obs/mem_contract.py) — tier-1.
+
+The acceptance pair from ISSUE 8: a real CPU train+valid run under
+``LGBM_TPU_MEM_CONTRACT=1`` shows ZERO steady-state growth, and an
+injected leak (the ``mem.leak`` fault point appending per-window
+device arrays into a module-lifetime sink) trips the contract, names
+the span, and emits ``mem:watermark_violation`` events.  Plus unit
+coverage of the Watermark mechanics (injectable sampler) and the
+serving harness's per-batch section.
+"""
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.boosting import gbdt as gbdt_mod
+from lightgbm_tpu.obs import mem_contract
+from lightgbm_tpu.utils import faults
+
+
+def _data(seed=7, n=400, nv=150):
+    rng = np.random.RandomState(seed)
+    X = rng.rand(n, 5)
+    y = (X[:, 0] + 0.2 * rng.rand(n) > 0.6).astype(np.float64)
+    Xv = rng.rand(nv, 5)
+    yv = (Xv[:, 0] + 0.2 * rng.rand(nv) > 0.6).astype(np.float64)
+    return X, y, Xv, yv
+
+
+def _train_windowed(X, y, Xv, yv, iters=16):
+    train = lgb.Dataset(X, label=y)
+    valid = lgb.Dataset(Xv, label=yv, reference=train)
+    return lgb.train(
+        {"objective": "binary", "num_iterations": iters, "num_leaves": 7,
+         "min_data_in_leaf": 5, "output_freq": 2, "verbose": -1},
+        train, valid_sets=[valid])
+
+
+@pytest.fixture(autouse=True)
+def _clean_state():
+    obs.reset()
+    faults.clear()
+    gbdt_mod._MEM_LEAK_SINK.clear()
+    yield
+    obs.reset()
+    faults.clear()
+    gbdt_mod._MEM_LEAK_SINK.clear()
+
+
+# ---------------------------------------------------------------------------
+# acceptance: clean run flat, injected leak trips + names the span
+# ---------------------------------------------------------------------------
+def test_clean_cpu_train_zero_steady_growth(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_MEM_CONTRACT", "1")
+    X, y, Xv, yv = _data()
+    bst = _train_windowed(X, y, Xv, yv)
+    assert bst.num_trees() > 0
+    rep = obs.summary().get("mem_contract")
+    assert rep is not None, "mem_contract section missing"
+    assert rep["windows_sampled"] >= 4, rep
+    assert rep["source"] in ("memory_stats", "live_arrays"), rep
+    assert rep["violation_count"] == 0 and rep["steady_ok"], rep
+
+
+def test_injected_leak_trips_contract_and_names_span(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_MEM_CONTRACT", "1")
+    obs.enable()                        # events ride the summary
+    faults.inject("mem.leak", times=50)
+    X, y, Xv, yv = _data()
+    _train_windowed(X, y, Xv, yv)
+    assert faults.fired("mem.leak") >= 4
+    rep = obs.summary()["mem_contract"]
+    assert rep["violation_count"] >= 1 and not rep["steady_ok"], rep
+    # the violation NAMES the span that crossed the watermark
+    assert rep["violations"][0]["span"] == "gbdt.window", rep
+    assert rep["violations"][0]["grew_bytes"] > rep["violations"][0][
+        "tol_bytes"]
+    events = obs.summary()["events"]
+    assert events.get("mem:watermark_violation", 0) >= 1, events
+
+
+def test_contract_off_costs_nothing(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_MEM_CONTRACT", raising=False)
+    X, y, Xv, yv = _data()
+    _train_windowed(X, y, Xv, yv, iters=8)
+    assert "mem_contract" not in obs.summary()
+
+
+# ---------------------------------------------------------------------------
+# Watermark mechanics (injectable sampler)
+# ---------------------------------------------------------------------------
+def test_watermark_flags_growth_beyond_tolerance(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_MEM_TOL_BYTES", str(1 << 20))
+    monkeypatch.setenv("LGBM_TPU_MEM_TOL_FRAC", "0.0")
+    seq = iter([100 << 20,              # warmup (compile allocations)
+                10 << 20,               # steady baseline
+                10 << 20,               # flat: fine
+                (10 << 20) + (1 << 19),  # inside tolerance
+                13 << 20])              # leak: +3 MiB over baseline
+    wm = mem_contract.Watermark(
+        "unit", warmup=1, sampler=lambda: (next(seq), None, "test"))
+    for i in range(5):
+        wm.sample("unit.window", it=i)
+    rep = wm.report()
+    assert rep["baseline_bytes"] == 10 << 20
+    assert rep["violation_count"] == 1, rep
+    assert rep["violations"][0]["span"] == "unit.window"
+    assert not rep["steady_ok"]
+
+
+def test_watermark_unavailable_backend_is_silent():
+    wm = mem_contract.Watermark(
+        "unit", warmup=0, sampler=lambda: (0, None, "unavailable"))
+    for _ in range(4):
+        wm.sample("unit.window")
+    rep = wm.report()
+    assert rep["steady_ok"] and rep["source"] == "unavailable"
+
+
+def test_peak_hbm_bytes_contract():
+    """On backends without allocator stats (CPU tier-1) the bench hook
+    returns (None, reason); with stats it returns a positive int."""
+    peak, reason = mem_contract.peak_hbm_bytes()
+    assert (peak is None) != (reason is None)
+    if peak is not None:
+        assert peak > 0
+    else:
+        assert "memory_stats" in reason or "peak_bytes" in reason
+
+
+# ---------------------------------------------------------------------------
+# serving harness: per-batch section
+# ---------------------------------------------------------------------------
+def test_serve_batches_write_mem_section(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_MEM_CONTRACT", "1")
+    from lightgbm_tpu.serve import PredictionServer, compile_model
+    X, y, _, _ = _data(n=500)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, label=y), 4, verbose_eval=False)
+    cm = compile_model(bst)
+    srv = PredictionServer(cm, max_batch=256, max_wait_ms=1.0,
+                           buckets=(64, 256), min_bucket=64,
+                           raw_score=True)
+    futs = [srv.submit(X[(13 * i) % 300:][:7]) for i in range(24)]
+    for fu in futs:
+        fu.result(60)
+    srv.close()
+    rep = obs.summary().get("serve_mem_contract")
+    assert rep is not None, "serve_mem_contract section missing"
+    assert rep["kind"] == "serve" and rep["windows_sampled"] >= 1, rep
+    assert rep["steady_ok"], rep

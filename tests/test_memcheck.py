@@ -1,0 +1,219 @@
+"""Tier-1 gate: memcheck device-memory & donation-safety analysis.
+
+Mirrors the tpulint/spmdcheck gate layers:
+
+1. **Package gate** — ``lightgbm_tpu/`` must analyze clean against the
+   committed baseline (``tools/memcheck/baseline.json``, EMPTY), via
+   the shared umbrella run (``tools.check.cached_run_all``: one AST
+   parse serves all three static gates in a pytest session).
+2. **Rule correctness** — fixtures under ``memcheck_fixtures/`` carry
+   ``# EXPECT: MEMxxx`` markers; the analyzer must report EXACTLY the
+   marked (line, rule) pairs.
+3. **Seeded hazards** — the acceptance patterns: the PR 7
+   donation-aliasing shape (host ``np.asarray`` read of a donated
+   score buffer) seeded into a copy of ``gbdt.py`` fails the gate with
+   MEM001 at the right file:line, and a ``pallas_call`` without a VMEM
+   guard fails with MEM004.
+4. **Model plumbing** — the MEM003 footprint gate trips on a declared
+   budget violation, and the MEM004 guard registry stays in sync with
+   ``lightgbm_tpu/ops/vmem.py``.
+"""
+import json
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "memcheck_fixtures")
+
+from tools.analysis_core import assert_fixtures_match  # noqa: E402
+from tools.memcheck import (BASELINE_DEFAULT, load_baseline,  # noqa: E402
+                            new_findings, run_memcheck, write_baseline)
+
+
+# ---------------------------------------------------------------------------
+# 1. package gate (through the shared umbrella run)
+# ---------------------------------------------------------------------------
+def test_package_clean_vs_baseline():
+    from tools.check import cached_run_all
+    _, fresh = cached_run_all(REPO)["memcheck"]
+    assert not fresh, ("new memcheck findings (fix, suppress with "
+                       "justification, or --update-baseline):\n"
+                       + "\n".join(f.render() for f in fresh))
+
+
+def test_committed_baseline_is_empty():
+    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    assert baseline == {}, ("the memcheck baseline must stay EMPTY — "
+                            "fix or justify-suppress instead of pinning: "
+                            f"{baseline}")
+
+
+# ---------------------------------------------------------------------------
+# 2. rule correctness on fixtures
+# ---------------------------------------------------------------------------
+def test_fixtures_match_expect_markers():
+    findings, _ = run_memcheck([FIXTURES], root=REPO,
+                               project_rules=False)
+    checked = assert_fixtures_match(FIXTURES, findings)
+    assert checked >= 8     # pos+neg per file rule
+
+
+def test_suppression_clears_finding(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import jax\n\n"
+        "step = jax.jit(lambda s: s + 1.0)\n\n\n"
+        "def loop(state):\n"
+        "    # memcheck: disable=MEM002 -- bounded scratch, profiled\n"
+        "    state = step(state)\n"
+        "    return state\n")
+    findings, _ = run_memcheck(["mod.py"], root=str(tmp_path),
+                               project_rules=False)
+    assert not findings, [f.render() for f in findings]
+
+
+def test_baseline_roundtrip(tmp_path):
+    mod = tmp_path / "mod.py"
+    shutil.copy(os.path.join(FIXTURES, "mem002_pos.py"), mod)
+    findings, by_rel = run_memcheck(["mod.py"], root=str(tmp_path),
+                                    project_rules=False)
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), findings, by_rel)
+    again, by_rel2 = run_memcheck(["mod.py"], root=str(tmp_path),
+                                  project_rules=False)
+    assert not new_findings(again, by_rel2, load_baseline(str(bl_path)))
+    # a NEW hazard (distinct line text) surfaces through the pin
+    mod.write_text(mod.read_text() + (
+        "\n\ndef fresh_hazard(carry):\n"
+        "    carry = step(carry)\n"
+        "    return carry\n"))
+    third, by_rel3 = run_memcheck(["mod.py"], root=str(tmp_path),
+                                  project_rules=False)
+    fresh = new_findings(third, by_rel3, load_baseline(str(bl_path)))
+    assert len(fresh) == 1 and fresh[0].rule == "MEM002", \
+        [f.render() for f in fresh]
+
+
+# ---------------------------------------------------------------------------
+# 3. seeded hazards (the acceptance patterns)
+# ---------------------------------------------------------------------------
+# gbdt.py already imports jax and np at module scope; the seed reuses
+# them so the materialization call matches the recognized aliases
+MEM001_SEED = (
+    "\n\n_mc_donated_block = jax.jit(lambda s: s * 2.0,\n"
+    "                            donate_argnums=(0,))\n\n\n"
+    "def _mc_probe_read(scores):\n"
+    "    out = _mc_donated_block(scores)\n"
+    "    return out, np.asarray(scores)\n")
+
+
+def test_seeded_donation_aliasing_fails_gate(tmp_path):
+    """Acceptance: the PR 7 pre-fix shape — an ungated donate_argnums
+    jit consuming the score buffer plus a host np.asarray read of it —
+    seeded into a copy of gbdt.py fails the gate with MEM001 and the
+    correct file:line."""
+    pkg = tmp_path / "lightgbm_tpu"
+    shutil.copytree(os.path.join(REPO, "lightgbm_tpu"), pkg,
+                    ignore=shutil.ignore_patterns("__pycache__"))
+    target = pkg / "boosting" / "gbdt.py"
+    base_lines = len(target.read_text().splitlines())
+    target.write_text(target.read_text() + MEM001_SEED)
+    hazard_line = base_lines + 9        # the np.asarray read
+
+    findings, by_rel = run_memcheck(["lightgbm_tpu"], root=str(tmp_path))
+    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    fresh = new_findings(findings, by_rel, baseline)
+    assert any(f.rule == "MEM001"
+               and f.file == "lightgbm_tpu/boosting/gbdt.py"
+               and f.line == hazard_line for f in fresh), \
+        [f.render() for f in fresh]
+
+    # ... and the CLI exits non-zero printing file:line + rule id
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.memcheck", "--root", str(tmp_path),
+         "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert (f"lightgbm_tpu/boosting/gbdt.py:{hazard_line}: MEM001"
+            in proc.stdout), proc.stdout
+
+
+def test_seeded_unguarded_pallas_fails_gate(tmp_path):
+    """Acceptance: a pallas_call with no VMEM-model guard on its path
+    fails the gate with MEM004 at the call line."""
+    mod = tmp_path / "probe_kernel.py"
+    src = ("import jax\n"
+           "from jax.experimental import pallas as pl\n\n\n"
+           "def _kernel(x_ref, o_ref):\n"
+           "    o_ref[...] = x_ref[...]\n\n\n"
+           "def dispatch(x):\n"
+           "    return pl.pallas_call(\n"
+           "        _kernel,\n"
+           "        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype))(x)\n")
+    mod.write_text(src)
+    hazard_line = 10                    # the pallas_call line
+    findings, _ = run_memcheck(["probe_kernel.py"], root=str(tmp_path))
+    assert any(f.rule == "MEM004" and f.line == hazard_line
+               for f in findings), [f.render() for f in findings]
+
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.memcheck", "--root", str(tmp_path),
+         "probe_kernel.py"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert f"probe_kernel.py:{hazard_line}: MEM004" in proc.stdout, \
+        proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# 4. model plumbing
+# ---------------------------------------------------------------------------
+def test_footprint_budget_violation_trips_mem003(tmp_path):
+    """A declared target whose estimated live bytes exceed its budget
+    surfaces as MEM003; a generous budget stays clean."""
+    shapes_dir = tmp_path / "tools" / "memcheck"
+    shapes_dir.mkdir(parents=True)
+    spec = {"version": 1, "targets": [
+        {"name": "tiny_budget", "kind": "train", "rows": 10_500_000,
+         "features": 28, "max_bin": 63, "leaves": 255,
+         "budget_bytes": 1 << 20}]}
+    (shapes_dir / "shapes.json").write_text(json.dumps(spec))
+    (tmp_path / "mod.py").write_text("x = 1\n")
+    findings, _ = run_memcheck(["mod.py"], root=str(tmp_path))
+    mem3 = [f for f in findings if f.rule == "MEM003"]
+    assert len(mem3) == 1 and "tiny_budget" in mem3[0].message, \
+        [f.render() for f in findings]
+
+    spec["targets"][0]["budget_bytes"] = 1 << 40
+    (shapes_dir / "shapes.json").write_text(json.dumps(spec))
+    findings2, _ = run_memcheck(["mod.py"], root=str(tmp_path))
+    assert not [f for f in findings2 if f.rule == "MEM003"]
+
+
+def test_repo_targets_fit_their_budgets():
+    """The committed shapes.json targets (the bench legs) must fit
+    their HBM budgets — a footprint regression fails here first."""
+    from tools.memcheck.footprint import load_targets, target_footprint
+    targets, err = load_targets(
+        os.path.join(REPO, "tools", "memcheck", "shapes.json"))
+    assert err is None and len(targets) >= 5
+    names = {t.name for t in targets}
+    assert {"higgs_1m", "higgs_full", "mslr_255bin",
+            "serve_1m_bucket"} <= names
+    for t in targets:
+        fp = target_footprint(t)
+        assert 0 < fp.total_bytes <= t.budget_bytes, (
+            t.name, fp.total_bytes, t.budget_bytes, fp.parts)
+
+
+def test_guard_registry_matches_ops_vmem():
+    """MEM004's fallback registry must stay in sync with the library's
+    VMEM_GUARDS (the shapes the rule keys on when analyzing the repo
+    itself are read statically from ops/vmem.py)."""
+    from lightgbm_tpu.ops.vmem import VMEM_GUARDS
+    from tools.memcheck.rules import DEFAULT_VMEM_GUARDS, _load_vmem_guards
+    assert set(DEFAULT_VMEM_GUARDS) == set(VMEM_GUARDS)
+    assert set(_load_vmem_guards(REPO)) == set(VMEM_GUARDS)

@@ -207,6 +207,14 @@ def _build(tmp_path):
     return shim, libdir, pyver
 
 
+REFERENCE_R_HEADER = "/root/reference/include/LightGBM/lightgbm_R.h"
+
+
+@pytest.mark.skipif(
+    not os.path.exists(REFERENCE_R_HEADER),
+    reason="reference checkout not present at /root/reference (needed to "
+           "enumerate the 38 LGBM_*_R exports the R package .Calls); the "
+           "end-to-end mock-R driver test below still runs")
 def test_r_shim_compiles_and_exports(tmp_path):
     """The 38-function R surface compiles against the C API and exports
     every LGBM_*_R symbol the reference's R package .Calls."""
@@ -214,7 +222,7 @@ def test_r_shim_compiles_and_exports(tmp_path):
     syms = subprocess.run(["nm", "-D", str(shim)], capture_output=True,
                           text=True).stdout
     import re
-    ref = open("/root/reference/include/LightGBM/lightgbm_R.h").read()
+    ref = open(REFERENCE_R_HEADER).read()
     wanted = sorted(set(re.findall(r"LGBM_\w+_R\b", ref)))
     assert len(wanted) == 38
     missing = [w for w in wanted if w not in syms]

@@ -24,34 +24,19 @@ import sys
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 FIXTURES = os.path.join(REPO, "tests", "spmdcheck_fixtures")
 
+from tools.analysis_core import assert_fixtures_match  # noqa: E402
 from tools.spmdcheck import (BASELINE_DEFAULT, load_baseline,  # noqa: E402
                              new_findings, render_schedules,
                              run_spmdcheck, write_baseline)
 
-_EXPECT_RE = re.compile(
-    r"#\s*EXPECT(-NEXT)?:\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)")
-
-
-def _markers(path):
-    out = set()
-    with open(path, encoding="utf-8") as f:
-        for lineno, line in enumerate(f, 1):
-            m = _EXPECT_RE.search(line)
-            if not m:
-                continue
-            target = lineno + 1 if m.group(1) else lineno
-            for rule in m.group(2).split(","):
-                out.add((target, rule.strip()))
-    return out
-
 
 # ---------------------------------------------------------------------------
-# 1. package gate
+# 1. package gate (through the shared umbrella run: one AST parse
+#    serves the tpulint + spmdcheck + memcheck tier-1 gates)
 # ---------------------------------------------------------------------------
 def test_package_clean_vs_baseline():
-    findings, by_rel = run_spmdcheck(["lightgbm_tpu"], root=REPO)
-    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
-    fresh = new_findings(findings, by_rel, baseline)
+    from tools.check import cached_run_all
+    _, fresh = cached_run_all(REPO)["spmdcheck"]
     assert not fresh, ("new spmdcheck findings (fix, suppress with "
                        "justification, or --update-baseline):\n"
                        + "\n".join(f.render() for f in fresh))
@@ -99,31 +84,16 @@ def test_seeded_hazard_fails_gate(tmp_path):
             in proc.stdout), proc.stdout
 
 
-def test_cli_clean_exit_zero():
-    proc = subprocess.run(
-        [sys.executable, "-m", "tools.spmdcheck", "lightgbm_tpu"],
-        cwd=REPO, capture_output=True, text=True)
-    assert proc.returncode == 0, proc.stdout + proc.stderr
+# (the clean-CLI exit-zero check now rides the umbrella gate in
+# tests/test_check.py, which also asserts the combined runtime budget)
 
 
 # ---------------------------------------------------------------------------
 # 2. rule correctness on fixtures
 # ---------------------------------------------------------------------------
 def test_fixtures_match_expect_markers():
-    findings, by_rel = run_spmdcheck([FIXTURES], root=REPO)
-    got = {}
-    for f in findings:
-        got.setdefault(os.path.basename(f.file), set()).add((f.line, f.rule))
-    checked = 0
-    for name in sorted(os.listdir(FIXTURES)):
-        if not name.endswith(".py"):
-            continue
-        expected = _markers(os.path.join(FIXTURES, name))
-        actual = got.get(name, set())
-        assert actual == expected, (
-            f"{name}: expected {sorted(expected)}, got {sorted(actual)}")
-        checked += 1
-    assert checked >= 8     # pos+neg per rule
+    findings, _ = run_spmdcheck([FIXTURES], root=REPO)
+    assert assert_fixtures_match(FIXTURES, findings) >= 8
 
 
 def test_suppression_clears_finding(tmp_path):

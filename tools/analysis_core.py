@@ -1,0 +1,270 @@
+"""Shared plumbing for the repo's static analyzers (tpulint, spmdcheck,
+memcheck): file loading, one process-wide AST cache, inline suppression
+parsing, the content-keyed baseline, and the fixture EXPECT matcher.
+
+History: this started life as ``tools/tpulint/core.py`` (PR 3) and was
+imported wholesale by spmdcheck (PR 4).  With memcheck as the third
+consumer the plumbing moves here; ``tools/tpulint/core.py`` remains as
+a re-export shim so existing imports keep working.
+
+Design invariants every analyzer relies on:
+
+* **One parse per file per process** — ASTs are cached on
+  ``(path, mtime, size)``; running tpulint + spmdcheck + memcheck in one
+  process (``python -m tools.check``, or the three tier-1 gate tests in
+  one pytest session) parses each package file exactly once.
+* **Suppression syntax** is shared across analyzers, keyed by tag::
+
+      x = np.asarray(v)  # tpulint: disable=TPL003 -- host-only IO path
+      y = jax.lax.psum(y, ax)  # spmdcheck: disable=SPM001 -- masked
+      _SINK.append(a)  # memcheck: disable=MEM005 -- bounded by tests
+
+  A disable comment applies to its own line, or — when the line is
+  comment-only — to the next source line.  A disable WITHOUT a
+  justification (the ``-- reason`` tail) is reported by tpulint as
+  TPL000: every silenced hazard carries its why in-line.
+* **Baselines** pin pre-existing findings so gates fail only on NEW
+  ones.  Keys are ``file::rule::<stripped source line>`` — line-content
+  keyed, not line-number keyed, so unrelated edits above a pinned
+  finding don't break the pin — with a count per key.  All three
+  committed baselines are EMPTY and tests assert they stay that way.
+"""
+from __future__ import annotations
+
+import ast
+import json
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+# one regex serves every analyzer: each tool's tag suppresses its own
+# rule ids (rule-id sets are disjoint, so cross-tag suppression is
+# harmless and occasionally handy when one line trips two analyzers)
+_SUPPRESS_RE = re.compile(
+    r"#\s*(?:tpulint|spmdcheck|memcheck):\s*disable="
+    r"([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?\s*$")
+
+# fixture EXPECT markers (tests): `# EXPECT: TPL001` on the flagged
+# line, `# EXPECT-NEXT: MEM004` on the line above it
+_EXPECT_RE = re.compile(
+    r"#\s*EXPECT(-NEXT)?:\s*([A-Z]{3}\d{3}(?:\s*,\s*[A-Z]{3}\d{3})*)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One hazard: ``file`` is root-relative posix, ``line`` 1-based."""
+    file: str
+    line: int
+    rule: str
+    message: str
+
+    def render(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} {self.message}"
+
+
+@dataclass
+class FileInfo:
+    """A parsed source file plus its per-line suppression map."""
+    path: str                       # absolute
+    rel: str                        # root-relative, posix separators
+    source: str
+    lines: List[str]
+    tree: ast.Module
+    # line -> set of suppressed rule ids ("*" = all)
+    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
+    # lines whose disable comment carries no justification
+    unjustified: List[int] = field(default_factory=list)
+
+    @property
+    def basename(self) -> str:
+        return os.path.basename(self.rel)
+
+    def line_text(self, line: int) -> str:
+        if 1 <= line <= len(self.lines):
+            return self.lines[line - 1].strip()
+        return ""
+
+    def imports_jax(self) -> bool:
+        for node in self.tree.body:
+            if isinstance(node, ast.Import):
+                if any(a.name.split(".")[0] == "jax" for a in node.names):
+                    return True
+            elif isinstance(node, ast.ImportFrom):
+                if (node.module or "").split(".")[0] == "jax":
+                    return True
+        return False
+
+
+def _parse_suppressions(fi: FileInfo) -> None:
+    for i, raw in enumerate(fi.lines, 1):
+        m = _SUPPRESS_RE.search(raw)
+        if not m:
+            continue
+        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
+        reason = (m.group(2) or "").strip()
+        # comment-only disable line covers the next SOURCE line (a
+        # justification may wrap onto further comment lines)
+        target = i
+        if raw.strip().startswith("#"):
+            target = i + 1
+            while (target <= len(fi.lines)
+                   and (not fi.lines[target - 1].strip()
+                        or fi.lines[target - 1].strip().startswith("#"))):
+                target += 1
+        fi.suppressions.setdefault(target, set()).update(rules or {"*"})
+        if not reason:
+            fi.unjustified.append(i)
+
+
+# -- AST cache ------------------------------------------------------------
+_AST_CACHE: Dict[str, Tuple[Tuple[float, int], FileInfo]] = {}
+
+
+def load_file(path: str, root: str) -> Optional[FileInfo]:
+    """Parse ``path`` (cached on mtime+size); None on syntax errors —
+    a file the interpreter itself rejects is not an analyzer's job."""
+    path = os.path.abspath(path)
+    try:
+        st = os.stat(path)
+        stamp = (st.st_mtime, st.st_size)
+    except OSError:
+        return None
+    cached = _AST_CACHE.get(path)
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    if cached is not None and cached[0] == stamp:
+        fi = cached[1]
+        if fi.rel != rel:           # same file analyzed under another root
+            fi = FileInfo(path, rel, fi.source, fi.lines, fi.tree,
+                          fi.suppressions, fi.unjustified)
+        return fi
+    try:
+        with open(path, encoding="utf-8") as f:
+            source = f.read()
+        tree = ast.parse(source, filename=path)
+    except (OSError, SyntaxError, ValueError):
+        return None
+    fi = FileInfo(path=path, rel=rel, source=source,
+                  lines=source.splitlines(), tree=tree)
+    _parse_suppressions(fi)
+    _AST_CACHE[path] = (stamp, fi)
+    return fi
+
+
+def discover_files(paths: Sequence[str], root: str) -> List[FileInfo]:
+    """Expand files/directories into parsed FileInfos (sorted, deduped)."""
+    seen: Dict[str, None] = {}
+    for p in paths:
+        p = p if os.path.isabs(p) else os.path.join(root, p)
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for name in sorted(filenames):
+                    if name.endswith(".py"):
+                        seen[os.path.join(dirpath, name)] = None
+        elif p.endswith(".py"):
+            seen[os.path.abspath(p)] = None
+    out = []
+    for path in sorted(seen):
+        fi = load_file(path, root)
+        if fi is not None:
+            out.append(fi)
+    return out
+
+
+def suppressed(fi: FileInfo, finding: Finding) -> bool:
+    rules = fi.suppressions.get(finding.line)
+    return bool(rules) and ("*" in rules or finding.rule in rules)
+
+
+# -- baseline -------------------------------------------------------------
+def finding_key(f: Finding, fi: Optional[FileInfo]) -> str:
+    text = fi.line_text(f.line) if fi is not None else ""
+    return f"{f.file}::{f.rule}::{text}"
+
+
+def count_keys(findings: Sequence[Finding],
+               by_rel: Dict[str, FileInfo]) -> Dict[str, int]:
+    counts: Dict[str, int] = {}
+    for f in findings:
+        k = finding_key(f, by_rel.get(f.file))
+        counts[k] = counts.get(k, 0) + 1
+    return counts
+
+
+def load_baseline(path: str) -> Dict[str, int]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except (OSError, ValueError):
+        return {}
+    entries = data.get("entries", {}) if isinstance(data, dict) else {}
+    return {str(k): int(v) for k, v in entries.items()}
+
+
+def write_baseline(path: str, findings: Sequence[Finding],
+                   by_rel: Dict[str, FileInfo],
+                   tool: str = "tools.tpulint") -> None:
+    entries = count_keys(findings, by_rel)
+    data = {"version": 1,
+            "comment": f"pinned pre-existing findings; refresh with "
+                       f"`python -m {tool} --update-baseline`",
+            "entries": {k: entries[k] for k in sorted(entries)}}
+    tmp = path + ".tmp"
+    with open(tmp, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=False)
+        f.write("\n")
+    os.replace(tmp, path)
+
+
+def new_findings(findings: Sequence[Finding],
+                 by_rel: Dict[str, FileInfo],
+                 baseline: Dict[str, int]) -> List[Finding]:
+    """Findings beyond the baselined count for their key (oldest-first
+    occurrences of a key are considered the pinned ones)."""
+    budget = dict(baseline)
+    out = []
+    for f in findings:
+        k = finding_key(f, by_rel.get(f.file))
+        if budget.get(k, 0) > 0:
+            budget[k] -= 1
+        else:
+            out.append(f)
+    return out
+
+
+# -- fixture EXPECT matcher (shared by the three gate test files) ---------
+def expect_markers(path: str) -> Set[Tuple[int, str]]:
+    """{(lineno, rule)} findings a fixture file declares it expects."""
+    out: Set[Tuple[int, str]] = set()
+    with open(path, encoding="utf-8") as f:
+        for lineno, line in enumerate(f, 1):
+            m = _EXPECT_RE.search(line)
+            if not m:
+                continue
+            target = lineno + 1 if m.group(1) else lineno
+            for rule in m.group(2).split(","):
+                out.add((target, rule.strip()))
+    return out
+
+
+def assert_fixtures_match(fixtures_dir: str, findings: Sequence[Finding]
+                          ) -> int:
+    """Assert the analyzer reported EXACTLY the (line, rule) pairs each
+    fixture under ``fixtures_dir`` declares; returns the fixture count
+    checked (callers assert a minimum so an empty dir can't pass)."""
+    got: Dict[str, Set[Tuple[int, str]]] = {}
+    for f in findings:
+        got.setdefault(os.path.basename(f.file), set()).add(
+            (f.line, f.rule))
+    checked = 0
+    for name in sorted(os.listdir(fixtures_dir)):
+        if not name.endswith(".py"):
+            continue
+        expected = expect_markers(os.path.join(fixtures_dir, name))
+        actual = got.get(name, set())
+        assert actual == expected, (
+            f"{name}: expected {sorted(expected)}, got {sorted(actual)}")
+        checked += 1
+    return checked

@@ -1,0 +1,109 @@
+"""Umbrella static gate: ``python -m tools.check [--root R] [paths...]``.
+
+Runs all three analyzers — tpulint (TPL000-TPL008), spmdcheck
+(SPM001-SPM004), memcheck (MEM001-MEM005) — over ONE shared AST parse
+(``tools/analysis_core.py``'s process-wide cache: each file is parsed
+exactly once no matter how many analyzers visit it) and diffs each
+against its own committed baseline.  Exit 0 = all clean, 1 = any new
+finding, 2 = usage error.
+
+This is what the tier-1 gate tests call (``tests/test_tpulint.py`` /
+``test_spmdcheck.py`` / ``test_memcheck.py`` share one in-process
+:func:`cached_run_all`), and the one command a developer needs before
+pushing::
+
+    python -m tools.check
+
+Per-analyzer CLIs remain for focused work (``--update-baseline``,
+``--schedule``, ``--footprint`` live there).
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.analysis_core import Finding, load_baseline, new_findings
+
+
+def run_all(paths: Sequence[str] = ("lightgbm_tpu",),
+            root: Optional[str] = None,
+            project_rules: bool = True,
+            ) -> Dict[str, Tuple[List[Finding], List[Finding]]]:
+    """Run the three analyzers over one parse; -> name ->
+    (all_findings, new_vs_baseline)."""
+    from tools.memcheck import (BASELINE_DEFAULT as MEM_BL, run_memcheck)
+    from tools.spmdcheck import (BASELINE_DEFAULT as SPM_BL, run_spmdcheck)
+    from tools.tpulint import (BASELINE_DEFAULT as TPL_BL, run_lint)
+    root = os.path.abspath(root or os.getcwd())
+    out: Dict[str, Tuple[List[Finding], List[Finding]]] = {}
+    for name, runner, bl in (
+            ("tpulint",
+             lambda: run_lint(paths, root=root, project_rules=project_rules),
+             TPL_BL),
+            ("spmdcheck", lambda: run_spmdcheck(paths, root=root), SPM_BL),
+            ("memcheck",
+             lambda: run_memcheck(paths, root=root,
+                                  project_rules=project_rules),
+             MEM_BL)):
+        findings, by_rel = runner()
+        baseline = load_baseline(os.path.join(root, bl))
+        out[name] = (findings, new_findings(findings, by_rel, baseline))
+    return out
+
+
+# one shared analysis per (root, paths) per process: the three tier-1
+# gate tests each assert their own analyzer's verdict off this cache,
+# so a pytest session pays for ONE parse + analysis pass, not three
+_RUN_CACHE: Dict[Tuple[str, Tuple[str, ...]], Dict] = {}
+
+
+def cached_run_all(root: str, paths: Sequence[str] = ("lightgbm_tpu",)
+                   ) -> Dict[str, Tuple[List[Finding], List[Finding]]]:
+    key = (os.path.abspath(root), tuple(paths))
+    if key not in _RUN_CACHE:
+        _RUN_CACHE[key] = run_all(paths, root=root)
+    return _RUN_CACHE[key]
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.check",
+        description="combined static gate: tpulint + spmdcheck + "
+                    "memcheck over one shared AST parse")
+    parser.add_argument("paths", nargs="*", default=["lightgbm_tpu"])
+    parser.add_argument("--root", default=None,
+                        help="project root (default: cwd)")
+    parser.add_argument("--no-project-rules", action="store_true",
+                        help="skip repo-level rules (TPL005/TPL008 "
+                             "doc+oracle checks, MEM003 footprint gate)")
+    args = parser.parse_args(argv)
+    root = os.path.abspath(args.root or os.getcwd())
+    t0 = time.perf_counter()
+    try:
+        results = run_all(args.paths or ["lightgbm_tpu"], root=root,
+                          project_rules=not args.no_project_rules)
+    except OSError as exc:
+        print(f"check: {exc}", file=sys.stderr)
+        return 2
+    rc = 0
+    for name, (findings, fresh) in results.items():
+        for f in fresh:
+            print(f.render())
+        pinned = len(findings) - len(fresh)
+        if fresh:
+            rc = 1
+            print(f"{name}: {len(fresh)} new finding(s)"
+                  + (f" ({pinned} baselined)" if pinned else ""))
+        else:
+            print(f"{name}: clean"
+                  + (f" ({pinned} baselined)" if pinned else ""))
+    print(f"check: {'FAIL' if rc else 'ok'} "
+          f"({time.perf_counter() - t0:.2f}s, one shared parse)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,80 @@
+"""memcheck — device-memory & donation-safety analyzer.
+
+The third static gate (after tpulint and spmdcheck), aimed at the
+resource that gates every remaining scaling item: device memory.
+Rules MEM001-MEM005 (see ``rules.py``) run as a tier-1 gate via
+``tests/test_memcheck.py`` / ``python -m tools.check`` and by hand::
+
+    python -m tools.memcheck [--update-baseline] [--footprint] [paths...]
+
+Shares the analyzer plumbing in ``tools/analysis_core.py`` (one AST
+parse per file per process, ``# memcheck: disable=MEMxxx -- why``
+suppressions, content-keyed baseline — committed EMPTY).  The RUNTIME
+half is the HBM watermark contract
+(``lightgbm_tpu/obs/mem_contract.py``, ``LGBM_TPU_MEM_CONTRACT=1``);
+this package only analyzes source.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.analysis_core import (FileInfo, Finding, count_keys,
+                                 discover_files, load_baseline,
+                                 new_findings, suppressed, write_baseline)
+
+from .rules import FILE_RULES, PROJECT_RULES, RULE_TITLES, build_context
+
+BASELINE_DEFAULT = os.path.join("tools", "memcheck", "baseline.json")
+
+__all__ = [
+    "run_memcheck", "Finding", "RULE_TITLES", "load_baseline",
+    "write_baseline", "new_findings", "BASELINE_DEFAULT",
+]
+
+
+def run_memcheck(paths: Sequence[str] = ("lightgbm_tpu",),
+                 root: Optional[str] = None,
+                 project_rules: bool = True,
+                 ) -> Tuple[List[Finding], Dict[str, FileInfo]]:
+    """Analyze ``paths``; returns (findings sorted by location, FileInfo
+    by relative path).  Inline suppressions applied; the baseline is NOT
+    — callers diff via :func:`new_findings` (same contract as tpulint).
+    ``project_rules=False`` skips MEM003 (the shapes.json footprint
+    gate) for fixture runs."""
+    root = os.path.abspath(root or os.getcwd())
+    files = discover_files(paths, root)
+    ctx = build_context(files, root, project_rules=project_rules)
+    findings: List[Finding] = []
+    for fi in files:
+        for rule in FILE_RULES:
+            for f in rule(fi, ctx):
+                if not suppressed(fi, f):
+                    findings.append(f)
+    if project_rules:
+        for rule in PROJECT_RULES:
+            findings.extend(rule(ctx))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings, ctx.by_rel
+
+
+def render_footprints(root: Optional[str] = None) -> List[str]:
+    """Human-readable per-target footprint table (the ``--footprint``
+    CLI dump): every shapes.json target with its estimated live bytes,
+    budget, and headroom."""
+    from .footprint import load_targets, target_footprint
+    root = os.path.abspath(root or os.getcwd())
+    targets, err = load_targets(
+        os.path.join(root, "tools", "memcheck", "shapes.json"))
+    if err is not None:
+        return [f"shapes.json unreadable: {err}"]
+    lines = []
+    for t in targets:
+        fp = target_footprint(t)
+        lines.append(
+            f"{t.name} ({t.kind}): {fp.total_bytes / 1e9:.3f} GB "
+            f"estimated / {t.budget_bytes / 1e9:.2f} GB budget "
+            f"({fp.total_bytes / t.budget_bytes:.1%})")
+        for k, v in sorted(fp.parts.items(), key=lambda kv: -kv[1]):
+            lines.append(f"  {k}: {v / 1e6:.1f} MB")
+    return lines

@@ -1,0 +1,160 @@
+"""MEM003's closed-form per-dispatch footprint model.
+
+The reference engine budgets memory as a first-class design axis (its
+``.bin`` dataset cache and histogram-pool sizing — SURVEY.md, LightGBM
+v2.1.0); this module is the TPU port's analog: for each REPRESENTATIVE
+shape declared in ``tools/memcheck/shapes.json`` (the bench legs:
+1M/10.5M HIGGS, the MSLR 255-bin ranking store, the serve buckets),
+estimate the LIVE device bytes of one training/serving dispatch in
+closed form and gate it against that target's HBM budget.
+
+The model mirrors the allocations the code actually makes (pure int
+arithmetic — no jax import, so the static gate stays cheap and can run
+where jax can't):
+
+* binned store: ``[n, F]`` uint8 + the ``[F_pad, n_pad]`` transposed
+  kernel copy (``transpose_bins`` pads rows to the row tile, features
+  to 8);
+* score state: ``[n, K]`` f32 train scores (+ valid scores when the
+  target declares valid rows) — ONE live set with donation, two in the
+  undonated A/B (the model charges the donated steady state and adds
+  one extra set as dispatch headroom);
+* gradients/hessians: 2x ``[n, K]`` f32 (donated into the build, so
+  one generation live at a time; headroom charged as above);
+* bagging mask ``[n]`` bool + routed leaf ``[n]`` i32 + row values
+  ``[n]`` f32;
+* histogram state: ``leaves x F x bin_stride x 3`` f32 (grad/hess/
+  count per (leaf, feature, bin)) plus one in-flight wave accumulator
+  ``128-slot x F x bin_stride x C(=5)`` f32 (the wide kernel's padded
+  output block);
+* block-scan tree stack: ``block_cap x leaves`` x ~8 i32/f32 fields;
+* serve targets: the packed forest ``[T, M]`` node tensors (~9 x i32/
+  f32 fields at ``M = 2*leaves``) + one padded ``[bucket, F]`` f32
+  input + binned uint8 copy + ``[bucket, K]`` scores.
+
+Numbers are ESTIMATES with a declared slack factor — the gate exists
+to catch order-of-magnitude regressions (a new per-row f32 temp at
+10.5M rows, a forgotten second score set) before a TPU run OOMs, not
+to account every byte.
+"""
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+LANE = 128
+
+
+def _next_pow2(x: int) -> int:
+    return 1 << max(0, (x - 1).bit_length())
+
+
+def _round_up(x: int, m: int) -> int:
+    return -(-x // m) * m
+
+
+def bin_stride(max_bin: int) -> int:
+    return max(8, _next_pow2(max_bin))
+
+
+@dataclass
+class Target:
+    name: str
+    kind: str                    # "train" | "serve"
+    budget_bytes: int
+    rows: int = 0
+    features: int = 0
+    max_bin: int = 255
+    leaves: int = 255
+    classes: int = 1
+    valid_rows: int = 0
+    block_cap: int = 32
+    trees: int = 0               # serve
+    bucket_rows: int = 0         # serve
+    slack: float = 1.25
+
+
+@dataclass
+class Footprint:
+    parts: Dict[str, int] = field(default_factory=dict)
+
+    @property
+    def total_bytes(self) -> int:
+        return int(sum(self.parts.values()))
+
+
+def load_targets(path: str) -> Tuple[List[Target], Optional[str]]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            data = json.load(f)
+    except FileNotFoundError:
+        return [], None             # no shapes declared: rule inactive
+    except (OSError, ValueError) as exc:
+        return [], f"{type(exc).__name__}: {exc}"
+    out = []
+    try:
+        default_budget = int(data.get("default_budget_bytes", 14 << 30))
+        for t in data.get("targets", []):
+            out.append(Target(
+                name=str(t["name"]), kind=str(t.get("kind", "train")),
+                budget_bytes=int(t.get("budget_bytes", default_budget)),
+                rows=int(t.get("rows", 0)),
+                features=int(t.get("features", 0)),
+                max_bin=int(t.get("max_bin", 255)),
+                leaves=int(t.get("leaves", 255)),
+                classes=int(t.get("classes", 1)),
+                valid_rows=int(t.get("valid_rows", 0)),
+                block_cap=int(t.get("block_cap", 32)),
+                trees=int(t.get("trees", 0)),
+                bucket_rows=int(t.get("bucket_rows", 0)),
+                slack=float(t.get("slack", 1.25))))
+    except (KeyError, TypeError, ValueError) as exc:
+        return [], f"bad target spec: {type(exc).__name__}: {exc}"
+    return out, None
+
+
+def train_footprint(t: Target) -> Footprint:
+    n, F, K = t.rows, t.features, max(1, t.classes)
+    B = bin_stride(t.max_bin)
+    n_pad = _round_up(n, 2048)
+    F_pad = _round_up(F, 8)
+    fp = Footprint()
+    fp.parts["bins"] = n * F                       # [n, F] uint8
+    fp.parts["bins_transposed"] = F_pad * n_pad    # [F_pad, n_pad] uint8
+    # one live score generation (donated in-place update) + one
+    # dispatch-headroom set for the result materializing before the
+    # donor is released
+    fp.parts["scores"] = 2 * n * K * 4
+    if t.valid_rows:
+        fp.parts["valid_scores"] = 2 * t.valid_rows * K * 4
+        fp.parts["valid_bins"] = t.valid_rows * F
+    fp.parts["grad_hess"] = 2 * 2 * n * K * 4
+    fp.parts["bag_mask"] = n
+    fp.parts["row_leaf_values"] = n * 4 + n * 4
+    # full sibling-subtract histogram state + one in-flight wave block
+    fp.parts["hist_state"] = t.leaves * F * B * 3 * 4
+    wave_cols = _round_up(5 * 128, LANE)     # C=5 cols x 128-slot cap
+    fp.parts["wave_hist"] = F * B * wave_cols * 4
+    fp.parts["tree_stack"] = t.block_cap * K * t.leaves * 8 * 4
+    for k in fp.parts:
+        fp.parts[k] = int(fp.parts[k] * t.slack)
+    return fp
+
+
+def serve_footprint(t: Target) -> Footprint:
+    F, K = t.features, max(1, t.classes)
+    M = 2 * t.leaves                              # padded node slots
+    fp = Footprint()
+    fp.parts["forest_pack"] = t.trees * M * 9 * 4  # [T, M] x ~9 fields
+    fp.parts["input_batch"] = t.bucket_rows * F * 4
+    fp.parts["binned_batch"] = t.bucket_rows * F
+    fp.parts["scores"] = t.bucket_rows * K * 4
+    fp.parts["walk_state"] = t.bucket_rows * 2 * 4  # per-row node cursor
+    for k in fp.parts:
+        fp.parts[k] = int(fp.parts[k] * t.slack)
+    return fp
+
+
+def target_footprint(t: Target) -> Footprint:
+    return serve_footprint(t) if t.kind == "serve" else train_footprint(t)

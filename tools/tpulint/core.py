@@ -1,215 +1,16 @@
-"""tpulint core: file loading, AST cache, suppressions, baseline.
-
-The linter is a single parse pass per file (ASTs are cached per
-``(path, mtime, size)``, shared by every rule — the tier-1 budget is
-~10 s for the whole package) plus a set of AST rules (``rules.py``)
-and project-level consistency checks (``doccheck.py``).
-
-Suppression contract (documented in README "Static analysis")::
-
-    x = np.array(v)  # tpulint: disable=TPL003 -- host-only text IO path
-
-A disable comment applies to its own line, or — when the line is
-comment-only — to the next source line.  A disable WITHOUT a
-justification (the ``-- reason`` tail) is itself reported as TPL000:
-the whole point of the gate is that every silenced hazard carries its
-why in-line.
-
-The baseline (``tools/tpulint/baseline.json``) pins pre-existing
-findings so the gate fails only on NEW ones.  Keys are
-``file::rule::<stripped source line>`` — line-content keyed, not
-line-number keyed, so unrelated edits above a pinned finding don't
-break the pin — with a count per key (duplicate identical lines in one
-file share a key).
+"""Compatibility shim: the analyzer plumbing that lived here through
+PRs 3-4 (AST cache, suppressions, content-keyed baseline) moved to
+``tools/analysis_core.py`` when memcheck became its third consumer.
+Everything re-exports so existing ``from tools.tpulint.core import ...``
+sites (spmdcheck, tests) keep working unchanged.
 """
 from __future__ import annotations
 
-import ast
-import json
 import os
-import re
-from dataclasses import dataclass, field
-from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis_core import (  # noqa: F401 - re-exported surface
+    _AST_CACHE, _SUPPRESS_RE, FileInfo, Finding, assert_fixtures_match,
+    count_keys, discover_files, expect_markers, finding_key,
+    load_baseline, load_file, new_findings, suppressed, write_baseline)
 
 BASELINE_DEFAULT = os.path.join("tools", "tpulint", "baseline.json")
-
-# one parse serves both static gates: spmdcheck (tools/spmdcheck) shares
-# the suppression syntax under its own tag
-_SUPPRESS_RE = re.compile(
-    r"#\s*(?:tpulint|spmdcheck):\s*disable="
-    r"([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?\s*$")
-
-
-@dataclass(frozen=True)
-class Finding:
-    """One hazard: ``file`` is root-relative posix, ``line`` 1-based."""
-    file: str
-    line: int
-    rule: str
-    message: str
-
-    def render(self) -> str:
-        return f"{self.file}:{self.line}: {self.rule} {self.message}"
-
-
-@dataclass
-class FileInfo:
-    """A parsed source file plus its per-line suppression map."""
-    path: str                       # absolute
-    rel: str                        # root-relative, posix separators
-    source: str
-    lines: List[str]
-    tree: ast.Module
-    # line -> set of suppressed rule ids ("*" = all)
-    suppressions: Dict[int, Set[str]] = field(default_factory=dict)
-    # lines whose disable comment carries no justification
-    unjustified: List[int] = field(default_factory=list)
-
-    @property
-    def basename(self) -> str:
-        return os.path.basename(self.rel)
-
-    def line_text(self, line: int) -> str:
-        if 1 <= line <= len(self.lines):
-            return self.lines[line - 1].strip()
-        return ""
-
-    def imports_jax(self) -> bool:
-        for node in self.tree.body:
-            if isinstance(node, ast.Import):
-                if any(a.name.split(".")[0] == "jax" for a in node.names):
-                    return True
-            elif isinstance(node, ast.ImportFrom):
-                if (node.module or "").split(".")[0] == "jax":
-                    return True
-        return False
-
-
-def _parse_suppressions(fi: FileInfo) -> None:
-    for i, raw in enumerate(fi.lines, 1):
-        m = _SUPPRESS_RE.search(raw)
-        if not m:
-            continue
-        rules = {r.strip() for r in m.group(1).split(",") if r.strip()}
-        reason = (m.group(2) or "").strip()
-        # comment-only disable line covers the NEXT source line
-        target = i + 1 if raw.strip().startswith("#") else i
-        fi.suppressions.setdefault(target, set()).update(rules or {"*"})
-        if not reason:
-            fi.unjustified.append(i)
-
-
-# -- AST cache ------------------------------------------------------------
-_AST_CACHE: Dict[str, Tuple[Tuple[float, int], FileInfo]] = {}
-
-
-def load_file(path: str, root: str) -> Optional[FileInfo]:
-    """Parse ``path`` (cached on mtime+size); None on syntax errors —
-    a file the interpreter itself rejects is not this linter's job."""
-    path = os.path.abspath(path)
-    try:
-        st = os.stat(path)
-        stamp = (st.st_mtime, st.st_size)
-    except OSError:
-        return None
-    cached = _AST_CACHE.get(path)
-    rel = os.path.relpath(path, root).replace(os.sep, "/")
-    if cached is not None and cached[0] == stamp:
-        fi = cached[1]
-        if fi.rel != rel:           # same file linted under another root
-            fi = FileInfo(path, rel, fi.source, fi.lines, fi.tree,
-                          fi.suppressions, fi.unjustified)
-        return fi
-    try:
-        with open(path, encoding="utf-8") as f:
-            source = f.read()
-        tree = ast.parse(source, filename=path)
-    except (OSError, SyntaxError, ValueError):
-        return None
-    fi = FileInfo(path=path, rel=rel, source=source,
-                  lines=source.splitlines(), tree=tree)
-    _parse_suppressions(fi)
-    _AST_CACHE[path] = (stamp, fi)
-    return fi
-
-
-def discover_files(paths: Sequence[str], root: str) -> List[FileInfo]:
-    """Expand files/directories into parsed FileInfos (sorted, deduped)."""
-    seen: Dict[str, None] = {}
-    for p in paths:
-        p = p if os.path.isabs(p) else os.path.join(root, p)
-        if os.path.isdir(p):
-            for dirpath, dirnames, filenames in os.walk(p):
-                dirnames[:] = [d for d in dirnames
-                               if d not in ("__pycache__", ".git")]
-                for name in sorted(filenames):
-                    if name.endswith(".py"):
-                        seen[os.path.join(dirpath, name)] = None
-        elif p.endswith(".py"):
-            seen[os.path.abspath(p)] = None
-    out = []
-    for path in sorted(seen):
-        fi = load_file(path, root)
-        if fi is not None:
-            out.append(fi)
-    return out
-
-
-def suppressed(fi: FileInfo, finding: Finding) -> bool:
-    rules = fi.suppressions.get(finding.line)
-    return bool(rules) and ("*" in rules or finding.rule in rules)
-
-
-# -- baseline -------------------------------------------------------------
-def finding_key(f: Finding, fi: Optional[FileInfo]) -> str:
-    text = fi.line_text(f.line) if fi is not None else ""
-    return f"{f.file}::{f.rule}::{text}"
-
-
-def count_keys(findings: Sequence[Finding],
-               by_rel: Dict[str, FileInfo]) -> Dict[str, int]:
-    counts: Dict[str, int] = {}
-    for f in findings:
-        k = finding_key(f, by_rel.get(f.file))
-        counts[k] = counts.get(k, 0) + 1
-    return counts
-
-
-def load_baseline(path: str) -> Dict[str, int]:
-    try:
-        with open(path, encoding="utf-8") as f:
-            data = json.load(f)
-    except (OSError, ValueError):
-        return {}
-    entries = data.get("entries", {}) if isinstance(data, dict) else {}
-    return {str(k): int(v) for k, v in entries.items()}
-
-
-def write_baseline(path: str, findings: Sequence[Finding],
-                   by_rel: Dict[str, FileInfo]) -> None:
-    entries = count_keys(findings, by_rel)
-    data = {"version": 1,
-            "comment": "pinned pre-existing tpulint findings; refresh "
-                       "with `python -m tools.tpulint --update-baseline`",
-            "entries": {k: entries[k] for k in sorted(entries)}}
-    tmp = path + ".tmp"
-    with open(tmp, "w", encoding="utf-8") as f:
-        json.dump(data, f, indent=1, sort_keys=False)
-        f.write("\n")
-    os.replace(tmp, path)
-
-
-def new_findings(findings: Sequence[Finding],
-                 by_rel: Dict[str, FileInfo],
-                 baseline: Dict[str, int]) -> List[Finding]:
-    """Findings beyond the baselined count for their key (oldest-first
-    occurrences of a key are considered the pinned ones)."""
-    budget = dict(baseline)
-    out = []
-    for f in findings:
-        k = finding_key(f, by_rel.get(f.file))
-        if budget.get(k, 0) > 0:
-            budget[k] -= 1
-        else:
-            out.append(f)
-    return out
