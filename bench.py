@@ -405,6 +405,157 @@ def wave_microbench(dryrun: bool = False, f: int = None, max_bin: int = None,
     return table
 
 
+# split-finder microbench shapes (ISSUE 9): the reference's own
+# headline leaf/bin configs.  Rows land in the `split_finder` table and
+# (on TPU runs) fill north_star.json's pending-capture spec.
+SPLIT_FINDER_SHAPES = (
+    {"leaves": 63, "max_bin": 63}, {"leaves": 63, "max_bin": 255},
+    {"leaves": 255, "max_bin": 63}, {"leaves": 255, "max_bin": 255},
+)
+
+
+def split_finder_microbench(dryrun: bool = False):
+    """Per-wave split-scan cost, CACHED (the per-leaf best-split cache:
+    scan only the ``2A`` newly-histogrammed child slots, ISSUE 9) vs
+    FULL (the ``LGBM_TPU_SPLIT_CACHE=0`` rescan of every leaf slot) —
+    the O(A·F·B) vs O(L·F·B) regime the reference's
+    ``best_split_per_leaf_`` economy wins at 255 leaves.
+
+    One row per (leaves, max_bin) shape: per-wave wall for both scan
+    widths, ns per scanned leaf·feature·bin, and the speedup (full /
+    cached).  Both scans run the SAME feature-chunked
+    ``find_best_splits`` XLA path the 255-leaf learner uses (the fused
+    Pallas kernel is row-count-gated off at bench scale).  On TPU the
+    feature width is the MSLR 136; in ``--dryrun`` (or off-TPU) shapes
+    shrink to CPU-friendly widths — mechanics + the asymptotic ratio,
+    not absolute throughput."""
+    import jax
+    import jax.numpy as jnp
+    from lightgbm_tpu.ops.split import SplitParams, find_best_splits
+    from lightgbm_tpu.ops.vmem import bin_stride, split_scan_chunk_features
+    interp = dryrun or jax.default_backend() != "tpu"
+    F = 8 if interp else 136
+    reps = 3 if interp else 8
+    act = int(os.environ.get("BENCH_SPLIT_ACT", 8))   # splits/tail wave
+    params = SplitParams(min_data_in_leaf=20)
+    rng = np.random.RandomState(5)
+    nb_np = np.full(F, 0, np.int32)
+    table = []
+    for spec in SPLIT_FINDER_SHAPES:
+        L, mb = spec["leaves"], spec["max_bin"]
+        B = bin_stride(mb)
+        A2 = min(2 * act, L)                   # cached: both new children
+        nb = jnp.asarray(nb_np + mb)
+        mt = jnp.zeros(F, jnp.int32)
+        db = jnp.zeros(F, jnp.int32)
+        ic = jnp.zeros(F, bool)
+        g = rng.normal(size=(L, F, B)).astype(np.float32)
+        h = rng.uniform(0.01, 1.0, size=(L, F, B)).astype(np.float32)
+        c = rng.uniform(0.0, 50.0, size=(L, F, B)).astype(np.float32)
+        hist = jnp.asarray(np.stack([g, h, c], axis=-1))   # [L, F, B, 3]
+        lsg = jnp.sum(hist[:, 0, :, 0], axis=-1)
+        lsh = jnp.sum(hist[:, 0, :, 1], axis=-1)
+        lcnt = jnp.sum(hist[:, 0, :, 2], axis=-1)
+
+        def scan(grid, sg, sh, sc):
+            fc = split_scan_chunk_features(grid.shape[0], F, B)
+            return find_best_splits(
+                grid, sg, sh, sc, nb, mt, db, ic, params, None,
+                any_categorical=False, any_missing=True,
+                feature_chunk=fc).gain
+
+        scan_jit = jax.jit(scan)
+
+        def timed(grid):
+            args = (grid, lsg[:grid.shape[0]], lsh[:grid.shape[0]],
+                    lcnt[:grid.shape[0]])
+            _sync(scan_jit(*args))             # warm: compile
+            best = float("inf")
+            for _ in range(reps):              # min-of-reps: dispatch
+                t0 = time.time()               # noise must not fake a
+                _sync(scan_jit(*args))         # regression (or a win)
+                best = min(best, time.time() - t0)
+            return best
+
+        cached_s = timed(hist[:A2])
+        full_s = timed(hist)
+        table.append({
+            "leaves": L, "max_bin": mb, "features": F,
+            "cached_slots": A2, "full_slots": L,
+            "cached_us_per_wave": round(cached_s * 1e6, 2),
+            "full_us_per_wave": round(full_s * 1e6, 2),
+            "cached_ns_per_lfb": round(cached_s * 1e9 / (A2 * F * B), 4),
+            "full_ns_per_lfb": round(full_s * 1e9 / (L * F * B), 4),
+            "speedup": round(full_s / max(cached_s, 1e-12), 2),
+        })
+    return table
+
+
+# keys the rank_grad microbench must emit — `--dryrun` validates them
+# (tests/test_bench_budget), proving the per-bucket obj.rank_grad.<M>
+# spans fire alongside the measured ns/doc
+RANK_GRAD_SCHEMA_KEYS = (
+    "rank_grad_docs", "rank_grad_queries", "rank_grad_ns_per_doc",
+    "rank_grad_buckets", "rank_grad_bucket_spans")
+
+
+def rank_grad_microbench(dryrun: bool = False):
+    """ns/doc of ``LambdarankNDCG.get_gradients`` at the MSLR bucket
+    mix (ISSUE 9 satellite: the OTHER half of the 0.27x ranking-leg
+    attribution — per-query lambda cost vs split-find/routing).  Runs
+    the objective EAGERLY (per-bucket dispatches host-blocked at the
+    end) under telemetry, so the ``obj.rank_grad.<M>`` spans record
+    which query-size bucket dominates."""
+    import gc
+    import jax.numpy as jnp
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import Metadata
+    from lightgbm_tpu.objective.objectives import LambdarankNDCG
+    import jax
+    interp = dryrun or jax.default_backend() != "tpu"
+    nq = int(os.environ.get("BENCH_RANK_GRAD_QUERIES",
+                            200 if interp else 19_000))
+    reps = 2 if interp else 4
+    rng = np.random.RandomState(7)
+    # the ranking leg's own MSLR-like query-size mix
+    sizes = np.clip(np.round(rng.lognormal(mean=4.55, sigma=0.7,
+                                           size=nq)),
+                    1, 1251).astype(np.int64)
+    n = int(sizes.sum())
+    raw = rng.normal(size=n)
+    rel = np.digitize(raw, np.quantile(raw, [0.55, 0.78, 0.92, 0.98])
+                      ).astype(np.float32)
+    obj = LambdarankNDCG(Config.from_params({"objective": "lambdarank"}))
+    obj.init(Metadata(label=rel,
+                      query_boundaries=np.concatenate(
+                          [[0], np.cumsum(sizes)]).astype(np.int32)), n)
+    score = jnp.asarray(rng.normal(size=n).astype(np.float32))
+    obs.enable()
+    spans0 = {k: v.get("count", 0)
+              for k, v in obs.summary()["spans"].items()
+              if k.startswith("obj.rank_grad.")}
+    _sync(obj.get_gradients(score)[0])         # warm: compile buckets
+    t0 = time.time()
+    for _ in range(reps):
+        out = obj.get_gradients(score)[0]
+    _sync(out)
+    per = (time.time() - t0) / reps
+    spans = obs.summary()["spans"]
+    bucket_spans = sorted(
+        int(k.rsplit(".", 1)[1]) for k, v in spans.items()
+        if k.startswith("obj.rank_grad.")
+        and v.get("count", 0) > spans0.get(k, 0))
+    res = {"rank_grad_docs": n, "rank_grad_queries": nq,
+           "rank_grad_ns_per_doc": round(per / n * 1e9, 3),
+           "rank_grad_buckets": len(obj.buckets),
+           "rank_grad_bucket_spans": bucket_spans,
+           "rank_grad_bucket_mix": "MSLR lognormal(4.55,0.7) clip 1..1251"}
+    del obj, score
+    gc.collect()
+    return res
+
+
 # keys every serve (predict) leg must emit — `--dryrun` validates this
 # schema at toy shape as the tier-1 mechanics gate (tests/test_bench_budget)
 SERVE_SCHEMA_KEYS = (
@@ -819,6 +970,31 @@ def _validate_north_star_aux(ns: dict):
         good = False
     detail["multichip"] = "measured" if isinstance(mc, list) else (
         "pending-capture" if good else "invalid")
+    ok = ok and good
+    # split_finder (ISSUE 9): measured rows carry positive cached/full
+    # walls + speedup, or an explicit pending-capture spec with shapes
+    sf = ns.get("split_finder")
+    if isinstance(sf, list):
+        good = bool(sf) and all(
+            float(r.get("cached_us_per_wave", 0)) > 0
+            and float(r.get("full_us_per_wave", 0)) > 0
+            and float(r.get("speedup", 0)) > 0 for r in sf)
+    elif isinstance(sf, dict):
+        good = (sf.get("status") == "pending-capture"
+                and bool(sf.get("shapes")))
+    else:
+        good = False
+    detail["split_finder"] = "measured" if isinstance(sf, list) else (
+        "pending-capture" if good else "invalid")
+    ok = ok and good
+    # rank_grad: a measured ns/doc dict or a pending-capture spec
+    rg = ns.get("rank_grad")
+    good = isinstance(rg, dict) and (
+        rg.get("status") == "pending-capture"
+        or float(rg.get("ns_per_doc", 0)) > 0)
+    detail["rank_grad"] = ("measured" if isinstance(rg, dict)
+                           and "ns_per_doc" in rg else
+                           ("pending-capture" if good else "invalid"))
     return ok and good, detail
 
 
@@ -859,6 +1035,41 @@ def dryrun_main():
     except Exception as exc:        # noqa: BLE001 - reported on the line
         line["wave_aux_ok"] = False
         line["wave_aux_error"] = f"{type(exc).__name__}: {exc}"
+    # split-finder microbench gate (ISSUE 9): the cached changed-slot
+    # scan must beat the LGBM_TPU_SPLIT_CACHE=0 full rescan >=4x at the
+    # 255-leaf/255-bin shape — the acceptance ratio, validated as
+    # tier-1 (tests/test_bench_budget)
+    try:
+        sf = split_finder_microbench(dryrun=True)
+        line["split_finder"] = sf
+        r255 = next(r for r in sf
+                    if r["leaves"] == 255 and r["max_bin"] == 255)
+        line["split_finder_speedup_255"] = r255["speedup"]
+        line["split_finder_ok"] = bool(
+            len(sf) == len(SPLIT_FINDER_SHAPES)
+            and all(r["cached_us_per_wave"] > 0
+                    and r["full_us_per_wave"] > 0
+                    and r["speedup"] > 0 for r in sf)
+            and r255["speedup"] >= 4.0)
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["split_finder_ok"] = False
+        line["split_finder_leg"] = f"failed: {type(exc).__name__}: {exc}"
+    # rank_grad microbench gate: schema + the per-bucket
+    # obj.rank_grad.<M> spans actually fired for every bucket
+    try:
+        rg = rank_grad_microbench(dryrun=True)
+        line.update(rg)
+        missing = [k for k in RANK_GRAD_SCHEMA_KEYS if k not in rg]
+        line["rank_grad_ok"] = bool(
+            not missing and rg["rank_grad_ns_per_doc"] > 0
+            and rg["rank_grad_buckets"] > 0
+            and len(rg["rank_grad_bucket_spans"])
+            == rg["rank_grad_buckets"])
+        if missing:
+            line["rank_grad_schema_missing"] = missing
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["rank_grad_ok"] = False
+        line["rank_grad_leg"] = f"failed: {type(exc).__name__}: {exc}"
     # multichip mechanics gate: the REAL leg on a 2-device virtual CPU
     # pool (re-exec'd child) — schema + overlap bit-parity validated as
     # tier-1 (tests/test_bench_budget)
@@ -1116,6 +1327,27 @@ def main():
                 line.update(aux)
                 line["partial"] = "headline-1M+waves-aux"
                 _emit(line)
+
+    # split-finder microbench (ISSUE 9): cached changed-slot scan vs
+    # the LGBM_TPU_SPLIT_CACHE=0 full rescan at the reference's own
+    # leaf/bin configs — cheap (a few dispatches), emitted
+    # incrementally so a later driver deadline can't erase it
+    if os.environ.get("BENCH_SPLIT_FINDER", "1") != "0":
+        sf = _leg(line, "split_finder", split_finder_microbench)
+        if sf is not None:
+            line["split_finder"] = sf
+            line["partial"] = "headline-1M+split-finder"
+            _emit(line)
+
+    # lambdarank gradient microbench (ISSUE 9 satellite): ns/doc at the
+    # MSLR bucket mix + per-bucket obj.rank_grad.<M> span attribution —
+    # the other half of the 0.27x ranking-leg accounting
+    if os.environ.get("BENCH_RANK_GRAD", "1") != "0":
+        rg = _leg(line, "rank_grad", rank_grad_microbench)
+        if rg is not None:
+            line.update(rg)
+            line["partial"] = "headline-1M+rank-grad"
+            _emit(line)
 
     if os.environ.get("BENCH_FULL", "1") != "0":
         n_full = int(os.environ.get("BENCH_FULL_ROWS", 10_500_000))

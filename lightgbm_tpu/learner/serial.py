@@ -116,6 +116,20 @@ def _round8(x: int) -> int:
     return -(-x // 8) * 8
 
 
+def split_cache_enabled() -> bool:
+    """Per-leaf best-split cache (ISSUE 9, the reference's
+    ``best_split_per_leaf_`` economy, `serial_tree_learner.cpp`): each
+    wave scans ONLY the newly-histogrammed child slots and merges them
+    into the ``[L]`` cache the selection reads — O(A·F·B) per wave
+    instead of O(L·F·B).  ``LGBM_TPU_SPLIT_CACHE=0`` restores the full
+    per-wave rescan of every leaf's histogram (the A/B baseline the
+    ``split_finder`` bench table measures); models are byte-identical
+    either way (unchanged histograms ⇒ unchanged gains ⇒ identical
+    argmax tie-breaks — gated by tests/test_split_cache.py)."""
+    return _os_env.environ.get("LGBM_TPU_SPLIT_CACHE", "1") not in (
+        "0", "false")
+
+
 # datasets at or below this row count take the single-body compile-lean
 # path (override for A/B: LGBM_TPU_COMPILE_LEAN_ROWS)
 import os as _os_env
@@ -465,8 +479,20 @@ def scan_grid(data: DeviceData, params: GrowthParams, feature_mask,
               hist_state, ids, grid, lsg, lsh, lc):
     """EFB unbundle + best-split rescan of the changed-leaf grids — the
     tail of :func:`rescan_changed`, split out so the overlapped wave
-    (`ops/overlap.py` reduce+apply) can share it verbatim."""
+    (`ops/overlap.py` reduce+apply) can share it verbatim.
+
+    With the per-leaf split cache OFF (``LGBM_TPU_SPLIT_CACHE=0``) the
+    changed-slot narrowing is discarded: every wave rescans the FULL
+    ``[L, F, B]`` histogram state and rewrites the whole cache — the
+    O(L·F·B) baseline.  Results are byte-identical (unchanged leaf
+    histograms rescan to the identical floats), only the scanned width
+    changes.  Either way the scan chunks its feature axis under the
+    shared HBM model (`ops/vmem.py split_scan_chunk_features`) so the
+    255-bin MSLR stack stays inside budget."""
     L = hist_state.shape[0]
+    if not split_cache_enabled():
+        ids = jnp.arange(L, dtype=jnp.int32)
+        grid = hist_state
     safe = jnp.clip(ids, 0, L - 1)
     if data.is_bundled:
         from ..ops.histogram import unbundle_grid
@@ -476,6 +502,7 @@ def scan_grid(data: DeviceData, params: GrowthParams, feature_mask,
                              bin_stride(data.max_bins))
     B = grid.shape[2]
     from ..ops.pallas_split import find_best_splits_pallas, split_kernel_ok
+    from ..ops.vmem import split_scan_chunk_features
     interp = _os_env.environ.get("LGBM_TPU_SPLIT_INTERPRET") == "1"
     if (split_kernel_ok(grid.shape[1], B, data.has_categorical,
                         num_rows=data.bins.shape[0])
@@ -488,12 +515,15 @@ def scan_grid(data: DeviceData, params: GrowthParams, feature_mask,
             params=params.split, feature_mask=feature_mask,
             any_missing=data.has_missing, interpret=interp)
     else:
+        fc = split_scan_chunk_features(grid.shape[0], grid.shape[1], B,
+                                       any_missing=data.has_missing)
         res = find_best_splits(grid, lsg[safe], lsh[safe], lc[safe],
                                data.num_bins, data.missing_types,
                                data.default_bins, data.is_categorical,
                                params.split, feature_mask,
                                any_categorical=data.has_categorical,
-                               any_missing=data.has_missing)
+                               any_missing=data.has_missing,
+                               feature_chunk=fc)
     return hist_state, ids, res
 
 

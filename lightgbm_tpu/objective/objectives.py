@@ -580,7 +580,15 @@ class LambdarankNDCG(ObjectiveFunction):
     def get_gradients(self, score):
         """Pairwise NDCG-delta-weighted lambdas over the bucketed
         [T, M] sorted-position pair grids (see ``init``).  Traceable —
-        runs inside the fused training block."""
+        runs inside the fused training block.
+
+        Each bucket dispatch is wrapped in an ``obj.rank_grad.<M>``
+        telemetry span (ISSUE 9 satellite): on the eager/debug paths
+        the spans attribute per-bucket wall-clock (which query-size
+        class of the MSLR mix dominates the 0.27x ranking leg); inside
+        a traced block they record trace-time and bucket counts.  The
+        ``rank_grad`` bench table measures the same mix end-to-end."""
+        from .. import obs
         grad = jnp.zeros_like(score)
         hess = jnp.zeros_like(score)
         # pair-grid entries per dispatched chunk: bounds the [C, T, M]
@@ -590,14 +598,15 @@ class LambdarankNDCG(ObjectiveFunction):
             Mb, T = bk["M"], bk["T"]
             nq = bk["idx"].shape[0]
             C = max(1, min(nq, budget // max(1, T * Mb)))
-            g, h = _lambdarank_bucket_grads(
-                score[bk["idx"]], bk["valid"], bk["label"], bk["gain"],
-                bk["imd"], self.discounts[:Mb],
-                jnp.float32(self.sigmoid), T=T, C=C)
-            grad = grad.at[bk["idx"].ravel()].add(
-                jnp.where(bk["valid"], g, 0.0).ravel())
-            hess = hess.at[bk["idx"].ravel()].add(
-                jnp.where(bk["valid"], h, 0.0).ravel())
+            with obs.span(f"obj.rank_grad.{Mb}", queries=nq, pair_rows=T):
+                g, h = _lambdarank_bucket_grads(
+                    score[bk["idx"]], bk["valid"], bk["label"], bk["gain"],
+                    bk["imd"], self.discounts[:Mb],
+                    jnp.float32(self.sigmoid), T=T, C=C)
+                grad = grad.at[bk["idx"].ravel()].add(
+                    jnp.where(bk["valid"], g, 0.0).ravel())
+                hess = hess.at[bk["idx"].ravel()].add(
+                    jnp.where(bk["valid"], h, 0.0).ravel())
         return grad, hess
 
     def to_string(self):

@@ -60,12 +60,18 @@ from .split import (K_EPSILON, K_MIN_SCORE, SplitParams, SplitResult,
 
 LANE = 128
 
-# F*B lane cap: at the old 32768 cap the kernel's [3*Lc, FB] f32
-# intermediates (ghc/gs/cl0/cl1, ~12 MB each at Lc=32) blew the ~16 MB
-# per-core VMEM and surfaced as a Mosaic compile crash instead of a
-# fallback (ADVICE r5 #1).  16384 keeps the minimum Lc=8 tile inside
-# the budget below; the tile shrinks as FB grows toward it.
-MAX_LANES = 16384
+# F*B lane cap PER KERNEL CALL: at the old 32768 cap the kernel's
+# [3*Lc, FB] f32 intermediates (ghc/gs/cl0/cl1, ~12 MB each at Lc=32)
+# blew the ~16 MB per-core VMEM and surfaced as a Mosaic compile crash
+# instead of a fallback (ADVICE r5 #1).  16384 keeps the minimum Lc=8
+# tile inside the budget below; the tile shrinks as FB grows toward it.
+# Wider feature sets now CHUNK the feature axis into per-call slices of
+# this width (ISSUE 9) instead of falling off the kernel path — the
+# chunk choice lives in the shared VMEM model
+# (`ops/vmem.py:split_lane_chunk_features`), so memcheck's MEM004 and
+# this dispatcher agree on where feasibility is decided.
+from .vmem import SPLIT_MAX_LANES as MAX_LANES
+from .vmem import split_lane_chunk_features
 
 # VMEM working-set budget for the leaf-tile choice: the kernel holds
 # roughly 6 concurrent [3*Lc, FB] f32 arrays in the missing path
@@ -149,7 +155,11 @@ def split_kernel_ok(num_features: int, B: int,
         return False
     if B & (B - 1) or B > 256:
         return False
-    if (num_features * B) % LANE != 0 or num_features * B > MAX_LANES:
+    FB = num_features * B
+    # at/below the lane cap the single-call path needs LANE alignment;
+    # above it the feature axis chunks into lane-aligned, zero-padded
+    # slices (split_lane_chunk_features), so any width is expressible
+    if FB <= MAX_LANES and FB % LANE != 0:
         return False
     if env in ("1", "true"):
         return True
@@ -295,9 +305,85 @@ def find_best_splits_pallas(grid: jnp.ndarray,
                             any_missing: bool = True,
                             interpret: bool = False) -> SplitResult:
     """Drop-in numerical-only twin of :func:`ops.split.find_best_splits`
-    over a ``[L2, F, B, 3]`` padded grid (``B`` = bin stride)."""
+    over a ``[L2, F, B, 3]`` padded grid (``B`` = bin stride).
+
+    Feature sets wider than the per-call lane cap (``F*B >
+    SPLIT_MAX_LANES`` — the 255-bin MSLR shape) run as PER-CHUNK kernel
+    calls over lane-aligned feature slices (`ops/vmem.py
+    split_lane_chunk_features`), merged on the raw packed gains with
+    the earlier chunk winning exact ties — the same lowest-feature
+    tie-break the single call's joint argmax applies.  Short last
+    chunks zero-pad their features (``num_bins = 0`` masks every lane
+    to ``K_MIN_SCORE``), so per-chunk results match the single-call
+    scan bitwise."""
     L2, F, Bg, _ = grid.shape
     assert Bg == B
+    if F * B <= MAX_LANES:
+        out = _scan_feature_chunk(
+            grid, leaf_sum_grad, leaf_sum_hess, leaf_count, num_bins,
+            missing_types, default_bins, feature_mask, B=B,
+            params=params, any_missing=any_missing, interpret=interpret)
+    else:
+        fc = split_lane_chunk_features(F, B)
+        out = None
+        for s in range(0, F, fc):
+            e = min(F, s + fc)
+            out_c = _scan_feature_chunk(
+                grid[:, s:e], leaf_sum_grad, leaf_sum_hess, leaf_count,
+                num_bins[s:e], missing_types[s:e], default_bins[s:e],
+                feature_mask[s:e] if feature_mask is not None else None,
+                B=B, params=params, any_missing=any_missing,
+                interpret=interpret, pad_features=fc)
+            if s:
+                out_c = out_c.at[:, 1].add(float(s))    # global feature id
+                take = out_c[:, 0] > out[:, 0]          # tie -> earlier chunk
+                out = jnp.where(take[:, None], out_c, out)
+            else:
+                out = out_c
+
+    parent_gain = leaf_split_gain(leaf_sum_grad, leaf_sum_hess,
+                                  params.lambda_l1, params.lambda_l2)
+    gain_shift = parent_gain + params.min_gain_to_split
+
+    b_lg, b_lh, b_lc = out[:, 4], out[:, 5], out[:, 6]
+    b_rg = leaf_sum_grad - b_lg
+    b_rh = leaf_sum_hess - b_lh
+    b_rc = leaf_count - b_lc
+    l1, l2 = params.lambda_l1, params.lambda_l2
+    return SplitResult(
+        gain=(out[:, 0] - gain_shift).astype(jnp.float32),
+        feature=out[:, 1].astype(jnp.int32),
+        threshold=out[:, 2].astype(jnp.int32),
+        default_left=out[:, 3] > 0.5,
+        is_categorical=jnp.zeros(L2, bool),
+        cat_mask=jnp.zeros((L2, B), bool),
+        left_sum_grad=b_lg, left_sum_hess=b_lh, left_count=b_lc,
+        right_sum_grad=b_rg, right_sum_hess=b_rh, right_count=b_rc,
+        left_output=leaf_output(b_lg, b_lh, l1, l2),
+        right_output=leaf_output(b_rg, b_rh, l1, l2),
+    )
+
+
+def _scan_feature_chunk(grid, leaf_sum_grad, leaf_sum_hess, leaf_count,
+                        num_bins, missing_types, default_bins,
+                        feature_mask, *, B: int, params: SplitParams,
+                        any_missing: bool, interpret: bool,
+                        pad_features: int = 0) -> jnp.ndarray:
+    """One lane-cap-sized kernel call: scan a ``[L2, Fc, B, 3]`` grid
+    slice and return the packed per-leaf winner ``[L2, LANE]`` (raw
+    gain, LOCAL feature, bin, default_left, left sums).  With
+    ``pad_features`` the slice zero-pads to that width (masked lanes,
+    LANE-aligned)."""
+    L2, F, Bg, _ = grid.shape
+    if pad_features and F < pad_features:
+        grid = jnp.pad(grid, ((0, 0), (0, pad_features - F),
+                              (0, 0), (0, 0)))
+        num_bins = jnp.pad(num_bins, (0, pad_features - F))
+        missing_types = jnp.pad(missing_types, (0, pad_features - F))
+        default_bins = jnp.pad(default_bins, (0, pad_features - F))
+        if feature_mask is not None:
+            feature_mask = jnp.pad(feature_mask, (0, pad_features - F))
+        F = pad_features
     FB = F * B
     Lc = _leaf_tile(L2, FB)
     L_pad = -(-L2 // Lc) * Lc
@@ -338,7 +424,7 @@ def find_best_splits_pallas(grid: jnp.ndarray,
 
     kern = functools.partial(
         _split_kernel, B=B, FB=FB, Lc=Lc, any_missing=any_missing)
-    out = pl.pallas_call(
+    return pl.pallas_call(
         kern,
         grid=(L_pad // Lc,),
         in_specs=[
@@ -352,25 +438,3 @@ def find_best_splits_pallas(grid: jnp.ndarray,
         out_shape=jax.ShapeDtypeStruct((L_pad, LANE), jnp.float32),
         interpret=interpret,
     )(*chans, tot, consts)[:L2]
-
-    parent_gain = leaf_split_gain(leaf_sum_grad, leaf_sum_hess,
-                                  params.lambda_l1, params.lambda_l2)
-    gain_shift = parent_gain + params.min_gain_to_split
-
-    b_lg, b_lh, b_lc = out[:, 4], out[:, 5], out[:, 6]
-    b_rg = leaf_sum_grad - b_lg
-    b_rh = leaf_sum_hess - b_lh
-    b_rc = leaf_count - b_lc
-    l1, l2 = params.lambda_l1, params.lambda_l2
-    return SplitResult(
-        gain=(out[:, 0] - gain_shift).astype(jnp.float32),
-        feature=out[:, 1].astype(jnp.int32),
-        threshold=out[:, 2].astype(jnp.int32),
-        default_left=out[:, 3] > 0.5,
-        is_categorical=jnp.zeros(L2, bool),
-        cat_mask=jnp.zeros((L2, B), bool),
-        left_sum_grad=b_lg, left_sum_hess=b_lh, left_count=b_lc,
-        right_sum_grad=b_rg, right_sum_hess=b_rh, right_count=b_rc,
-        left_output=leaf_output(b_lg, b_lh, l1, l2),
-        right_output=leaf_output(b_rg, b_rh, l1, l2),
-    )

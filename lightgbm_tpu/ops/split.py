@@ -106,7 +106,8 @@ def find_best_splits(hist: jnp.ndarray,
                      params: SplitParams,
                      feature_mask: jnp.ndarray | None = None,
                      any_categorical: bool = True,
-                     any_missing: bool = True) -> SplitResult:
+                     any_missing: bool = True,
+                     feature_chunk: int | None = None) -> SplitResult:
     """Best split for every leaf over every feature, fully vectorized.
 
     Args:
@@ -121,10 +122,60 @@ def find_best_splits(hist: jnp.ndarray,
       params: static SplitParams.
       feature_mask: optional ``[F]`` bool — feature_fraction sampling
         (`serial_tree_learner.cpp:240-266` analog).
+      feature_chunk: optional static chunk width along the FEATURE axis:
+        the scan runs per chunk and the per-chunk winners merge with the
+        argmax's first-max tie-break, bounding the live ``~10 x
+        [2, L, Fc, B]`` f32 stack (`ops/vmem.py
+        split_scan_chunk_features` picks Fc so the 255-bin MSLR shape
+        stays inside the HBM budget).  Every per-(leaf, feature) value
+        is feature-independent, so chunked == unchunked bitwise.
 
     Returns:
       SplitResult with per-leaf best splits.
     """
+    F = hist.shape[1]
+    l1, l2 = params.lambda_l1, params.lambda_l2
+    parent_gain = leaf_split_gain(leaf_sum_grad, leaf_sum_hess, l1, l2)
+    gain_shift = parent_gain + params.min_gain_to_split
+
+    def block(s, e):
+        fm = feature_mask[s:e] if feature_mask is not None else None
+        return _find_best_splits_block(
+            hist[:, s:e], leaf_sum_grad, leaf_sum_hess, leaf_count,
+            num_bins[s:e], missing_types[s:e], default_bins[s:e],
+            is_categorical[s:e], params, fm, any_categorical, any_missing)
+
+    if feature_chunk is None or feature_chunk >= F:
+        res = block(0, F)
+    else:
+        # merge on the RAW gain (pre-shift): chunks are in feature
+        # order and ties keep the EARLIER chunk, reproducing the
+        # global argmax's first-max winner exactly
+        res = None
+        for s in range(0, F, feature_chunk):
+            r = block(s, min(F, s + feature_chunk))
+            r = r._replace(feature=(r.feature + s).astype(jnp.int32))
+            if res is None:
+                res = r
+            else:
+                take = r.gain > res.gain
+                res = jax.tree.map(
+                    lambda cur, new: jnp.where(
+                        take.reshape((-1,) + (1,) * (cur.ndim - 1)),
+                        new, cur),
+                    res, r)
+    return res._replace(gain=(res.gain - gain_shift).astype(jnp.float32))
+
+
+def _find_best_splits_block(hist, leaf_sum_grad, leaf_sum_hess, leaf_count,
+                            num_bins, missing_types, default_bins,
+                            is_categorical, params: SplitParams,
+                            feature_mask, any_categorical: bool,
+                            any_missing: bool) -> SplitResult:
+    """One feature block of :func:`find_best_splits`: the full scan over
+    ``[L, Fc, B, 3]`` returning the per-leaf winner with its RAW gain
+    (no parent shift — the caller merges chunks on raw gains, then
+    subtracts the shift once)."""
     L, F, B, _ = hist.shape
     g = hist[..., 0]
     h = hist[..., 1]
@@ -138,9 +189,6 @@ def find_best_splits(hist: jnp.ndarray,
     l1, l2 = params.lambda_l1, params.lambda_l2
     min_d = params.min_data_in_leaf * 1.0
     min_h = params.min_sum_hessian_in_leaf
-
-    parent_gain = leaf_split_gain(leaf_sum_grad, leaf_sum_hess, l1, l2)  # [L]
-    gain_shift = parent_gain + params.min_gain_to_split
 
     valid_bin = bin_ids[None, :] < num_bins[:, None]                     # [F, B]
 
@@ -271,7 +319,7 @@ def find_best_splits(hist: jnp.ndarray,
         cat_mask_lr, best_feat[:, None, None], axis=1)[:, 0, :]          # [L, B]
 
     return SplitResult(
-        gain=(best_gain - gain_shift).astype(jnp.float32),
+        gain=best_gain.astype(jnp.float32),       # RAW (caller shifts)
         feature=best_feat.astype(jnp.int32),
         threshold=pick(best_bin).astype(jnp.int32),
         default_left=jnp.where(bf_cat, False, pick(num_default_left)),

@@ -46,6 +46,8 @@ VMEM_GUARDS = (
     "fused_config_ok",       # fused route+hist kernel
     "compact_config_ok",     # leaf-compacted deep-wave kernel
     "hist_cell_ok",          # the generic predicate below
+    "split_lane_chunk_features",   # fused split kernel's lane chunking
+    "split_scan_chunk_features",   # XLA split scan's HBM chunking
 )
 
 
@@ -122,3 +124,67 @@ def split_vmem_budget_bytes() -> int:
     arrays in its missing path — see ops/pallas_split.py)."""
     return int(float(os.environ.get("LGBM_TPU_SPLIT_VMEM_MB", 12))
                * (1 << 20))
+
+
+# ---------------------------------------------------------------------------
+# split-scan working-set model (ISSUE 9): both split-finder paths chunk
+# the FEATURE axis under the budgets below, so the 255-bin MSLR shape
+# (136 features x 256-bin stride) stays inside memory on either path.
+# ---------------------------------------------------------------------------
+
+# F*B lane cap per fused-split-kernel call (ops/pallas_split.py: at the
+# old 32768 cap the kernel's [3*Lc, FB] f32 intermediates blew the
+# ~16 MB/core VMEM).  Wider feature sets run as per-chunk kernel calls.
+SPLIT_MAX_LANES = 16384
+
+# concurrent [2, slots, F, B] f32 grids the XLA scan's missing-direction
+# variant holds live (lg/lh/lc, rg/rh/rc, num_gain, ok, var_best,
+# num_gain_b — ops/split.py:195-223); the no-missing path halves the
+# stack and drops the direction axis.
+SPLIT_SCAN_LIVE_GRIDS = 10
+SPLIT_SCAN_LIVE_GRIDS_NOMISS = 6
+
+
+def split_lane_chunk_features(num_features: int, B: int) -> int:
+    """Features per fused-split-kernel chunk: the largest count whose
+    F*B lane width fits ``SPLIT_MAX_LANES`` AND stays LANE-aligned (the
+    kernel's block width requirement).  ``B`` is the power-of-two bin
+    stride, so alignment needs chunk counts in multiples of
+    ``LANE // B`` when ``B < LANE``."""
+    fc = max(1, SPLIT_MAX_LANES // B)
+    step = max(1, LANE // B)
+    fc -= fc % step
+    return max(step, min(num_features, fc)) if fc else step
+
+
+def split_scan_bytes(slots: int, num_features: int, B: int,
+                     any_missing: bool = True) -> int:
+    """Live HBM bytes of one XLA split scan over a ``[slots, F, B]``
+    grid — the ~10-grid f32 stack of the missing-direction variant."""
+    if any_missing:
+        return SPLIT_SCAN_LIVE_GRIDS * 2 * slots * num_features * B * 4
+    return SPLIT_SCAN_LIVE_GRIDS_NOMISS * slots * num_features * B * 4
+
+
+def split_scan_budget_bytes() -> int:
+    """HBM budget for the split scan's live intermediates
+    (``LGBM_TPU_SPLIT_SCAN_MB`` overrides; default 512 MiB — small next
+    to the 14 GiB device budget, large enough that the default HIGGS
+    shapes never chunk)."""
+    return int(float(os.environ.get("LGBM_TPU_SPLIT_SCAN_MB", 512))
+               * (1 << 20))
+
+
+def split_scan_chunk_features(slots: int, num_features: int, B: int,
+                              any_missing: bool = True) -> int:
+    """Features per XLA-scan chunk so the live stack fits the budget.
+    Returns ``num_features`` (no chunking) when the whole scan fits —
+    the default HIGGS/63-bin shapes — and chunks only when the stack
+    would exceed the budget (the 255-bin MSLR regime).
+    ``LGBM_TPU_SPLIT_CHUNK_F`` forces an explicit chunk width."""
+    forced = os.environ.get("LGBM_TPU_SPLIT_CHUNK_F")
+    if forced:
+        return max(1, min(num_features, int(forced)))
+    per_f = split_scan_bytes(slots, 1, B, any_missing)
+    fc = max(1, split_scan_budget_bytes() // max(1, per_f))
+    return min(num_features, fc)

@@ -71,7 +71,8 @@ else:                               # jax 0.4.x fallback
 
 from ..io.device import DeviceData
 from ..learner.serial import (BuiltTree, GrowthParams, apply_hist_wave,
-                              build_tree, make_hist_fn)
+                              build_tree, make_hist_fn,
+                              split_cache_enabled)
 from ..ops.pallas_histogram import bin_stride
 from ..ops.split import (K_MIN_SCORE, SplitParams, SplitResult,
                          find_best_splits)
@@ -163,6 +164,12 @@ def make_feature_parallel_strategy(data: DeviceData, grad, hess,
         new_h = hist_fn(hist_leaf, act_small)            # [A, f_local, B, 3]
         hist_state, ids, grid = apply_hist_wave(
             hist_state, new_h, act_small, act_parent, act_sibling, L)
+        if not split_cache_enabled():
+            # split-cache escape hatch (ISSUE 9): full per-wave rescan
+            # of the local-column histogram state — the post-allgather
+            # global best is cached identically either way
+            ids = jnp.arange(L, dtype=jnp.int32)
+            grid = hist_state
         safe = jnp.clip(ids, 0, L - 1)
         if data.is_bundled:
             from ..ops.histogram import unbundle_grid
@@ -207,6 +214,12 @@ def make_voting_parallel_strategy(data: DeviceData, grad, hess,
         new_h = hist_fn(hist_leaf, act_small)            # local histograms
         hist_state, ids, grid = apply_hist_wave(
             hist_state, new_h, act_small, act_parent, act_sibling, L)
+        if not split_cache_enabled():
+            # escape hatch: vote + winner-column psum over every leaf
+            # slot (per-slot results are independent, so the selected
+            # splits — and the model — are byte-identical)
+            ids = jnp.arange(L, dtype=jnp.int32)
+            grid = hist_state
         safe = jnp.clip(ids, 0, L - 1)
         # local leaf totals from the local histogram (column 0's bins
         # contain every in-bag local row exactly once)

@@ -91,11 +91,13 @@ def test_deadline_skips_aux_legs_with_markers(bench_run):
     final = _parse_lines(bench_run.stdout)[-1]
     assert "partial" not in final           # the complete line
     assert final["value"] > 0               # headline retained
-    for leg in ("serve", "valid", "bin255", "rank", "rank63", "multichip"):
+    for leg in ("serve", "valid", "bin255", "rank", "rank63", "multichip",
+                "split_finder", "rank_grad"):
         assert final.get(f"{leg}_leg") == "skipped: budget", final
     assert final.get("real_data") == "skipped: budget"
     assert set(final.get("legs_skipped", [])) >= {
-        "serve", "valid", "bin255", "rank", "rank63", "multichip"}
+        "serve", "valid", "bin255", "rank", "rank63", "multichip",
+        "split_finder", "rank_grad"}
     # an explicit skip is not a failure: no legs_failed / hard-failed
     assert "legs_failed" not in final
     assert "legs_hard_failed" not in final
@@ -169,6 +171,30 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     assert out["wave_aux_ok"] is True, out.get("wave_aux_error")
     for key in ("wave_kernel_255", "wave_kernel_mslr"):
         assert all(r["wide_ns_per_row"] > 0 for r in out[key]), out[key]
+    # split-finder microbench gate (ISSUE 9): the cached changed-slot
+    # scan beats the LGBM_TPU_SPLIT_CACHE=0 full rescan >= 4x at the
+    # 255-leaf/255-bin shape, and every shape row is present and sane
+    assert out["split_finder_ok"] is True, out.get(
+        "split_finder_leg", out.get("split_finder"))
+    shapes = {(r["leaves"], r["max_bin"]) for r in out["split_finder"]}
+    assert shapes == {(63, 63), (63, 255), (255, 63), (255, 255)}
+    for r in out["split_finder"]:
+        assert r["cached_us_per_wave"] > 0 and r["full_us_per_wave"] > 0
+        assert r["cached_slots"] < r["full_slots"]
+    assert out["split_finder_speedup_255"] >= 4.0
+    # rank_grad microbench gate (ISSUE 9 satellite): measured ns/doc at
+    # the MSLR bucket mix AND one obj.rank_grad.<M> span per bucket
+    assert out["rank_grad_ok"] is True, out.get("rank_grad_leg")
+    from bench import RANK_GRAD_SCHEMA_KEYS
+    for key in RANK_GRAD_SCHEMA_KEYS:
+        assert key in out, key
+    assert out["rank_grad_ns_per_doc"] > 0
+    assert out["rank_grad_buckets"] > 0
+    assert len(out["rank_grad_bucket_spans"]) == out["rank_grad_buckets"]
+    # the extended north_star specs validate alongside the wave tables
+    for key in ("split_finder", "rank_grad"):
+        assert out["north_star_aux_detail"][key] in (
+            "measured", "pending-capture"), out["north_star_aux_detail"]
     # per-leg memory column (ISSUE 8): every dryrun leg carries
     # peak_hbm_bytes — int > 0 with allocator stats, else null + reason
     assert out["peak_hbm_schema_ok"] is True, out
@@ -220,6 +246,49 @@ def test_gate_bearing_hard_failure_zeroes_headline():
     assert "forced failure" in final.get("valid_leg", ""), final
     assert final["vs_baseline"] == 0.0, final
     assert final["value"] > 0          # the headline NUMBER is retained
+
+
+def test_split_finder_rank_grad_survive_midrun_kill():
+    """ISSUE 9 satellite: the split_finder and rank_grad tables are
+    emitted INCREMENTALLY (each as its own partial line, right after
+    the headline) — a hard kill (SIGKILL, the driver-timeout class)
+    immediately after the rank_grad checkpoint must leave a last
+    parseable line that carries BOTH tables."""
+    import time
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", ""),
+           "BENCH_ROWS": "2000", "BENCH_ITERS": "2",
+           "BENCH_LEAVES": "7", "BENCH_BIN": "15", "BENCH_FULL": "0"}
+    env.pop("XLA_FLAGS", None)
+    env.pop("BENCH_DATA", None)
+    env.pop("BENCH_DEADLINE_S", None)
+    proc = subprocess.Popen(
+        [sys.executable, os.path.join(REPO, "bench.py")],
+        cwd=REPO, env=env, stdout=subprocess.PIPE,
+        stderr=subprocess.DEVNULL, text=True)
+    lines, deadline = [], time.time() + 390
+    try:
+        for ln in proc.stdout:
+            lines.append(ln)
+            if '"headline-1M+rank-grad"' in ln or time.time() > deadline:
+                break
+    finally:
+        proc.kill()
+        proc.wait(30)
+    parsed = _parse_lines("".join(lines))
+    assert parsed, "".join(lines)
+    last = parsed[-1]
+    assert last.get("partial") == "headline-1M+rank-grad", last
+    # the kill happened mid-run; the artifact already carries both
+    assert last["value"] > 0
+    table = last["split_finder"]
+    assert {(r["leaves"], r["max_bin"]) for r in table} == {
+        (63, 63), (63, 255), (255, 63), (255, 255)}
+    assert all(r["cached_us_per_wave"] > 0
+               and r["full_us_per_wave"] > 0 for r in table)
+    assert last["rank_grad_ns_per_doc"] > 0
+    assert len(last["rank_grad_bucket_spans"]) > 0
 
 
 def test_auc_gate_tightened_beyond_085(bench_run):

@@ -227,11 +227,18 @@ def test_leaf_tile_budgets_against_lanes():
 
 def test_split_kernel_lane_cap_lowered():
     from lightgbm_tpu.ops import pallas_split as ps
+    from lightgbm_tpu.ops.vmem import split_lane_chunk_features
     ps.enable_split_kernel()
     # 128 features x 256 bins = 32768 lanes: the shape ADVICE r5 #1
-    # flagged as a VMEM-overflow compile crash — now rejected
-    assert not ps.split_kernel_ok(128, 256, False, num_rows=1000)
+    # flagged as a VMEM-overflow compile crash.  Since ISSUE 9 it is
+    # ACCEPTED again — but as per-chunk kernel calls whose lane width
+    # never exceeds the cap the crash forced (the per-call working set
+    # is what VMEM bounds, and the chunk model enforces it)
+    assert ps.split_kernel_ok(128, 256, False, num_rows=1000)
+    assert split_lane_chunk_features(128, 256) * 256 <= ps.MAX_LANES
     assert ps.split_kernel_ok(64, 256, False, num_rows=1000)
+    # an unchunkable misalignment below the cap still rejects
+    assert not ps.split_kernel_ok(3, 8, False, num_rows=1000)
 
 
 def test_split_kernel_disable_on_compile_error():

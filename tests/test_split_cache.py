@@ -1,0 +1,287 @@
+"""Per-leaf split-cache correctness (ISSUE 9).
+
+The wave learner carries a ``[L]`` best-split cache (the reference's
+``best_split_per_leaf_``, `serial_tree_learner.cpp`): each wave scans
+ONLY the newly-histogrammed child slots and merges them into the cache
+the split selection reads.  ``LGBM_TPU_SPLIT_CACHE=0`` restores the
+full per-wave rescan of every leaf slot's histogram — the O(L·F·B)
+baseline the ``split_finder`` bench table measures against.
+
+The contract under test:
+
+* models are BYTE-identical cache-on vs cache-off — unchanged
+  histograms rescan to unchanged gains, and unchanged gains hit
+  identical argmax tie-breaks — for the serial learner, bagging +
+  feature_fraction, and 2-shard data-parallel / voting meshes;
+* a 255-leaf / 255-bin golden build matches FIELD-FOR-FIELD across the
+  two paths (the regime the cache exists to win);
+* the feature-chunked scan paths are bitwise equal to the unchunked
+  scans (XLA chunk-merge, and the fused Pallas kernel's lane chunking
+  past the F*B cap), with the chunk widths coming from the shared
+  ``ops/vmem.py`` model.
+"""
+import os
+from contextlib import contextmanager
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.device import to_device
+from lightgbm_tpu.learner.serial import (GrowthParams, build_tree,
+                                         split_cache_enabled)
+from lightgbm_tpu.ops.split import SplitParams, find_best_splits
+from lightgbm_tpu.parallel.learners import build_tree_distributed
+from lightgbm_tpu.parallel.mesh import make_mesh
+
+TREE_FIELDS = ("feature", "threshold_bin", "default_left", "is_categorical",
+               "cat_mask", "left_child", "right_child", "gain",
+               "internal_value", "internal_count", "leaf_value",
+               "leaf_count", "leaf_depth", "num_leaves", "row_leaf")
+
+
+@contextmanager
+def _cache(flag: str):
+    prev = os.environ.get("LGBM_TPU_SPLIT_CACHE")
+    os.environ["LGBM_TPU_SPLIT_CACHE"] = flag
+    try:
+        yield
+    finally:
+        if prev is None:
+            os.environ.pop("LGBM_TPU_SPLIT_CACHE", None)
+        else:
+            os.environ["LGBM_TPU_SPLIT_CACHE"] = prev
+
+
+def _train_model(params, X, y, rounds=8):
+    bst = lgb.train(dict(params, verbose=-1), lgb.Dataset(X, label=y),
+                    num_boost_round=rounds, verbose_eval=False)
+    return bst._gbdt.save_model_to_string()
+
+
+def _xy(n=2500, f=10, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] - 0.5 * X[:, 2]
+         + 0.3 * rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def test_split_cache_env_default():
+    with _cache("1"):
+        assert split_cache_enabled()
+    with _cache("0"):
+        assert not split_cache_enabled()
+    prev = os.environ.pop("LGBM_TPU_SPLIT_CACHE", None)
+    try:
+        assert split_cache_enabled()        # cache ON by default
+    finally:
+        if prev is not None:
+            os.environ["LGBM_TPU_SPLIT_CACHE"] = prev
+
+
+def test_serial_model_identical_cache_on_off():
+    X, y = _xy()
+    params = {"objective": "binary", "num_leaves": 31,
+              "min_data_in_leaf": 10}
+    models = {}
+    for flag in ("1", "0"):
+        with _cache(flag):
+            models[flag] = _train_model(params, X, y)
+    assert models["1"] == models["0"]
+
+
+def test_bagged_feature_fraction_identical_cache_on_off():
+    """Sampling paths: bagging masks shrink leaf stats, the feature
+    mask narrows the scan — both must stay byte-identical through the
+    cache-off full rescan (same mask, same floats)."""
+    X, y = _xy(seed=3)
+    params = {"objective": "binary", "num_leaves": 31,
+              "min_data_in_leaf": 10, "bagging_freq": 2,
+              "bagging_fraction": 0.7, "feature_fraction": 0.6}
+    models = {}
+    for flag in ("1", "0"):
+        with _cache(flag):
+            models[flag] = _train_model(params, X, y, rounds=10)
+    assert models["1"] == models["0"]
+
+
+@pytest.fixture(scope="module")
+def two_devices():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    return jax.devices()[:2]
+
+
+@pytest.mark.parametrize("learner", ["data", "voting"])
+def test_mesh_model_identical_cache_on_off(two_devices, learner):
+    """Distributed learners: data-parallel merges the cache after the
+    psum'd grid; voting caches the post-merge winner — cache-off widens
+    the scanned slots (and, for voting/feature, the exchanged block) to
+    [L], but every per-slot result is independent, so the models stay
+    byte-identical."""
+    X, y = _xy(n=1600, f=8, seed=5)
+    params = {"objective": "binary", "num_leaves": 15,
+              "min_data_in_leaf": 10, "tree_learner": learner,
+              "mesh_shape": [2]}
+    models = {}
+    for flag in ("1", "0"):
+        with _cache(flag):
+            models[flag] = _train_model(params, X, y, rounds=4)
+    assert models["1"] == models["0"]
+
+
+def test_golden_255leaf_255bin_cache_equals_full():
+    """The regime the cache exists to win (ISSUE 9 acceptance): a deep
+    255-leaf / 255-bin build must produce the IDENTICAL tree
+    field-for-field on the cached changed-slot path and the full-rescan
+    path."""
+    rng = np.random.RandomState(11)
+    n, f = 1536, 6
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.7 * X[:, 1] * X[:, 2] - 0.4 * X[:, 3]
+         + 0.2 * rng.normal(size=n)).astype(np.float32)
+    dd = to_device(BinnedDataset.from_raw(
+        X, Config.from_params({"max_bin": 255})))
+    grad = jnp.asarray(-(y - y.mean()))
+    hess = jnp.ones(n)
+    p = GrowthParams(num_leaves=255, split=SplitParams(
+        min_data_in_leaf=1, min_sum_hessian_in_leaf=0.0))
+    trees = {}
+    for flag in ("1", "0"):
+        with _cache(flag):
+            trees[flag] = jax.tree.map(np.asarray,
+                                       build_tree(dd, grad, hess, p))
+    # the tree must actually reach the deep-tail regime the cache
+    # narrows (many tail waves at the full 128-slot width)
+    assert int(trees["1"].num_leaves) > 128
+    for fld in TREE_FIELDS:
+        np.testing.assert_array_equal(
+            getattr(trees["1"], fld), getattr(trees["0"], fld),
+            err_msg=fld)
+
+
+def _consistent_hist(seed, L2, F, B, n_rows=3000, cats=0):
+    """Histograms accumulated from simulated rows (per-feature bin sums
+    agree with the leaf totals), optionally with categorical columns."""
+    rng = np.random.RandomState(seed)
+    num_bins = rng.randint(B // 2, B + 1, size=F).astype(np.int32)
+    missing_types = rng.choice(
+        [MISSING_NONE, MISSING_NAN, MISSING_ZERO], size=F)
+    default_bins = np.array(
+        [rng.randint(0, nb) for nb in num_bins], np.int32)
+    is_cat = np.zeros(F, bool)
+    if cats:
+        is_cat[rng.choice(F, size=cats, replace=False)] = True
+    leaf = rng.randint(0, L2, size=n_rows)
+    g = rng.normal(size=n_rows)
+    h = np.abs(rng.normal(size=n_rows)) + 0.1
+    hist = np.zeros((L2, F, B, 3), np.float32)
+    for fi in range(F):
+        bins = rng.randint(0, num_bins[fi], size=n_rows)
+        np.add.at(hist[:, fi, :, 0], (leaf, bins), g)
+        np.add.at(hist[:, fi, :, 1], (leaf, bins), h)
+        np.add.at(hist[:, fi, :, 2], (leaf, bins), 1.0)
+    lsg = np.zeros(L2); lsh = np.zeros(L2); lc = np.zeros(L2)
+    np.add.at(lsg, leaf, g)
+    np.add.at(lsh, leaf, h)
+    np.add.at(lc, leaf, 1.0)
+    return (jnp.asarray(hist), jnp.asarray(lsg.astype(np.float32)),
+            jnp.asarray(lsh.astype(np.float32)),
+            jnp.asarray(lc.astype(np.float32)), jnp.asarray(num_bins),
+            jnp.asarray(missing_types), jnp.asarray(default_bins),
+            jnp.asarray(is_cat))
+
+
+@pytest.mark.parametrize("cats", [0, 3])
+def test_find_best_splits_feature_chunked_bitwise(cats):
+    """Feature-axis chunking of the XLA scan is BITWISE equal to the
+    unchunked scan for every chunk width — per-(leaf, feature) values
+    are feature-independent and the chunk merge reproduces the global
+    argmax's first-max tie-break — including the categorical and
+    missing-direction paths."""
+    (hist, lsg, lsh, lc, nb, mt, db,
+     ic) = _consistent_hist(7, L2=11, F=13, B=32, cats=cats)
+    p = SplitParams(min_data_in_leaf=5)
+    fm = jnp.asarray(np.random.RandomState(0).rand(13) > 0.2)
+    ref = find_best_splits(hist, lsg, lsh, lc, nb, mt, db, ic, p, fm,
+                           any_categorical=bool(cats))
+    for fc in (1, 3, 5, 12, 13, 100):
+        got = find_best_splits(hist, lsg, lsh, lc, nb, mt, db, ic, p, fm,
+                               any_categorical=bool(cats),
+                               feature_chunk=fc)
+        for fld in ref._fields:
+            np.testing.assert_array_equal(
+                np.asarray(getattr(ref, fld)),
+                np.asarray(getattr(got, fld)), err_msg=f"{fld} fc={fc}")
+
+
+def test_pallas_split_lane_chunked_matches_xla():
+    """The fused split kernel past the F*B lane cap: per-chunk kernel
+    calls over lane-aligned feature slices (zero-padded last chunk)
+    must reproduce the XLA scan's decisions — the MSLR-width
+    (F*B > SPLIT_MAX_LANES) regime, interpret mode."""
+    from lightgbm_tpu.ops.pallas_split import (find_best_splits_pallas,
+                                               split_kernel_ok)
+    from lightgbm_tpu.ops.vmem import (SPLIT_MAX_LANES,
+                                       split_lane_chunk_features)
+    L2, F, B = 8, 1040, 16
+    assert F * B > SPLIT_MAX_LANES
+    fc = split_lane_chunk_features(F, B)
+    assert fc * B <= SPLIT_MAX_LANES and (fc * B) % 128 == 0
+    assert split_kernel_ok(F, B, False, num_rows=100)
+    (hist, lsg, lsh, lc, nb, mt, db, _) = _consistent_hist(
+        13, L2=L2, F=F, B=B, n_rows=2500)
+    p = SplitParams(min_data_in_leaf=5)
+    ref = find_best_splits(hist, lsg, lsh, lc, nb, mt, db,
+                           jnp.zeros(F, bool), p, any_categorical=False)
+    got = find_best_splits_pallas(hist, lsg, lsh, lc, nb, mt, db, B=B,
+                                  params=p, interpret=True)
+    hs = np.asarray(ref.gain) > 0
+    assert hs.any()
+    np.testing.assert_array_equal(np.asarray(got.feature)[hs],
+                                  np.asarray(ref.feature)[hs])
+    np.testing.assert_array_equal(np.asarray(got.threshold)[hs],
+                                  np.asarray(ref.threshold)[hs])
+    np.testing.assert_array_equal(np.asarray(got.default_left)[hs],
+                                  np.asarray(ref.default_left)[hs])
+    np.testing.assert_allclose(np.asarray(got.gain)[hs],
+                               np.asarray(ref.gain)[hs],
+                               rtol=2e-4, atol=1e-5)
+
+
+def test_split_scan_chunk_model():
+    """The shared HBM chunk model (`ops/vmem.py`): no chunking at the
+    default HIGGS shapes, chunking at the 255-bin MSLR stack, explicit
+    env override, and the lane model's alignment contract."""
+    from lightgbm_tpu.ops.vmem import (split_lane_chunk_features,
+                                       split_scan_bytes,
+                                       split_scan_chunk_features)
+    # HIGGS 63-bin: whole scan fits -> no chunking
+    assert split_scan_chunk_features(256, 28, 64) == 28
+    # MSLR 255-bin full rescan: must chunk below F
+    fc = split_scan_chunk_features(256, 136, 256)
+    assert 1 <= fc < 136
+    assert split_scan_bytes(256, fc, 256) <= 512 << 20
+    # narrowed cached scan needs fewer chunks than the full width
+    assert split_scan_chunk_features(16, 136, 256) >= fc
+    prev = os.environ.get("LGBM_TPU_SPLIT_CHUNK_F")
+    os.environ["LGBM_TPU_SPLIT_CHUNK_F"] = "7"
+    try:
+        assert split_scan_chunk_features(256, 136, 256) == 7
+    finally:
+        if prev is None:
+            os.environ.pop("LGBM_TPU_SPLIT_CHUNK_F", None)
+        else:
+            os.environ["LGBM_TPU_SPLIT_CHUNK_F"] = prev
+    # lane chunking (engaged only past the F*B cap): aligned + capped
+    # for every bin stride, incl. sub-lane strides
+    for B in (8, 16, 64, 128, 256):
+        F = (16384 // B) * 2 + 5            # force > SPLIT_MAX_LANES
+        fcl = split_lane_chunk_features(F, B)
+        assert (fcl * B) % 128 == 0 and fcl * B <= 16384

@@ -39,11 +39,51 @@ to account every byte.
 """
 from __future__ import annotations
 
+import importlib.util
 import json
+import os as _os
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 LANE = 128
+
+# the wide histogram kernel's active-slot cap: the cached split scan's
+# worst per-wave width is 2 x this (both children of every slot)
+WAVE_SLOT_CAP = 128
+
+
+def _load_vmem_module():
+    """Load ``lightgbm_tpu/ops/vmem.py`` by PATH (pure int math, no jax
+    import) so the split-scan chunk model has ONE home — importing the
+    package would pull in jax, which this jax-free gate must not."""
+    p = _os.path.join(_os.path.dirname(_os.path.abspath(__file__)),
+                      "..", "..", "lightgbm_tpu", "ops", "vmem.py")
+    try:
+        spec = importlib.util.spec_from_file_location("_memcheck_vmem", p)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        return mod
+    except (OSError, ImportError, AttributeError, ValueError,
+            SyntaxError):
+        return None         # fallback formulas below
+
+
+_VMEM = _load_vmem_module()
+
+
+def _split_scan_part(slots: int, F: int, B: int) -> int:
+    """Live bytes of one feature-chunked split scan over ``[slots, F,
+    B]`` — the ~10-grid ``[2, slots, Fc, B]`` f32 stack of the
+    missing-direction variant (ISSUE 9), with ``Fc`` from the shared
+    chunk model (`ops/vmem.py split_scan_chunk_features`)."""
+    if _VMEM is not None:
+        fc = _VMEM.split_scan_chunk_features(slots, F, B)
+        return _VMEM.split_scan_bytes(slots, fc, B)
+    # fallback mirror of the vmem model (10 live [2, slots, Fc, B] f32)
+    budget = 512 << 20
+    per_f = 10 * 2 * slots * B * 4
+    fc = min(F, max(1, budget // max(1, per_f)))
+    return 10 * 2 * slots * fc * B * 4
 
 
 def _next_pow2(x: int) -> int:
@@ -136,6 +176,13 @@ def train_footprint(t: Target) -> Footprint:
     fp.parts["hist_state"] = t.leaves * F * B * 3 * 4
     wave_cols = _round_up(5 * 128, LANE)     # C=5 cols x 128-slot cap
     fp.parts["wave_hist"] = F * B * wave_cols * 4
+    # split-scan intermediates (ISSUE 9): the per-wave scan's ~10-grid
+    # f32 stack, feature-chunked under the shared vmem model.  Charged
+    # at the WORSE of the cached width (2 x the 128-slot wave cap) and
+    # the cache-off full rescan over every leaf slot — the budget gate
+    # must cover the escape-hatch A/B too
+    scan_slots = max(min(2 * WAVE_SLOT_CAP, 2 * t.leaves), t.leaves)
+    fp.parts["split_scan"] = _split_scan_part(scan_slots, F, B)
     fp.parts["tree_stack"] = t.block_cap * K * t.leaves * 8 * 4
     for k in fp.parts:
         fp.parts[k] = int(fp.parts[k] * t.slack)
