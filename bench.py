@@ -556,6 +556,108 @@ def rank_grad_microbench(dryrun: bool = False):
     return res
 
 
+# keys the device-time attribution leg must emit (ISSUE 10) —
+# `--dryrun` runs the REAL leg (profiled toy train, parsed capture) on
+# CPU and validates them as tier-1 (tests/test_bench_budget)
+ATTRIBUTION_SCHEMA_KEYS = (
+    "attribution_rows", "attribution_iters", "attribution_windows",
+    "attribution_device_time_s", "attribution_coverage",
+    "attribution_device_frac", "attribution_host_gap_frac",
+    "attribution_collective_frac", "attribution_top_programs",
+    "attribution_spans", "attribution_cost_programs",
+    "attribution_dispatch_gap_mean_s")
+
+
+def attribution_leg(dryrun: bool = False):
+    """Device-time attribution leg (ISSUE 10): a small train profiled
+    under ``LGBM_TPU_PROFILE`` (windowed capture: warmup window, then
+    bounded captured windows), reduced to per-leg artifact fields —
+    device / host-gap / collective fractions, top programs by device
+    time, per-program FLOPs/bytes from the XLA cost model, and the
+    always-on ``gbdt.dispatch_gap_mean_s`` host-latency gauge (the
+    ROADMAP item-1 signal).  The capture run is SEPARATE from the
+    timed legs: profiling overhead (trace + parse) must never sit
+    inside a throughput number.  Setting ``LGBM_TPU_PROFILE`` on the
+    whole bench process additionally profiles every leg's training —
+    this leg exists so the DEFAULT artifact always carries
+    attribution."""
+    import gc
+    import shutil
+    import tempfile
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+
+    # off-TPU the leg shrinks to toy shape (same rule as the wave /
+    # split-finder microbenches): the CPU backend traces one event per
+    # executed thunk, so a real-shape capture costs minutes of parse —
+    # mechanics there, measurement on TPU
+    toy = dryrun or jax.default_backend() != "tpu"
+    n = int(os.environ.get("BENCH_ATTR_ROWS", 1_500 if toy else 100_000))
+    # >= 3 profile windows: the warmup->capture and capture->stop
+    # boundaries are profiler transitions excluded from dispatch-gap
+    # accounting, so at least one plain boundary must remain to sample
+    # the gbdt.dispatch_gap_mean_s gauge
+    iters = int(os.environ.get("BENCH_ATTR_ITERS", 6 if toy else 10))
+    f = int(os.environ.get("BENCH_ATTR_FEATURES", 5 if toy else 28))
+    leaves = int(os.environ.get("BENCH_ATTR_LEAVES", 7 if toy else 63))
+    max_bin = int(os.environ.get("BENCH_ATTR_BIN", 15 if toy else 63))
+    rng = np.random.RandomState(13)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] - X[:, 2]
+         + rng.normal(scale=1.0, size=n) > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": leaves,
+              "max_bin": max_bin, "learning_rate": 0.1,
+              "min_data_in_leaf": 20, "verbose": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    del X
+    obs.enable()                    # dispatch-gap counters need live obs
+    td = tempfile.mkdtemp(prefix="lgbm_attr_")
+    prev = os.environ.get("LGBM_TPU_PROFILE")
+    os.environ["LGBM_TPU_PROFILE"] = td
+    try:
+        bst = lgb.train(params, ds, num_boost_round=iters,
+                        verbose_eval=False)
+    finally:
+        if prev is None:
+            os.environ.pop("LGBM_TPU_PROFILE", None)
+        else:
+            os.environ["LGBM_TPU_PROFILE"] = prev
+    s = obs.summary()
+    da = s.get("device_attribution") or {}
+    shutil.rmtree(td, ignore_errors=True)
+    if da.get("error") or "device_time_s" not in da:
+        raise RuntimeError("attribution capture failed: "
+                           f"{da.get('error', 'no capture produced')}")
+    wall = max(da.get("capture_wall_s") or 0.0, 1e-9)
+    wwall = max(da.get("window_wall_s") or wall, 1e-9)
+    cost = (da.get("cost_model") or {}).get("programs") or []
+    del bst, ds
+    gc.collect()
+    return {
+        "attribution_rows": n, "attribution_iters": iters,
+        "attribution_windows": da.get("windows"),
+        "attribution_device_time_s": da["device_time_s"],
+        "attribution_coverage": da.get("coverage"),
+        "attribution_device_frac": round(
+            (da.get("device_busy_s") or 0.0) / wall, 4),
+        "attribution_host_gap_frac": round(
+            (da.get("host_gap_s") or 0.0) / wwall, 4),
+        "attribution_collective_frac": da.get("collective_frac"),
+        "attribution_top_programs": da.get("top_programs"),
+        "attribution_spans": {
+            k: v["device_s"]
+            for k, v in list((da.get("spans") or {}).items())[:8]},
+        "attribution_cost_programs": [
+            {"program": r.get("program"), "flops": r.get("flops"),
+             "bytes_accessed": r.get("bytes_accessed"),
+             "arith_intensity": r.get("arith_intensity"),
+             "bound": r.get("bound")} for r in cost],
+        "attribution_dispatch_gap_mean_s": s.get("gauges", {}).get(
+            "gbdt.dispatch_gap_mean_s"),
+    }
+
+
 # keys every serve (predict) leg must emit — `--dryrun` validates this
 # schema at toy shape as the tier-1 mechanics gate (tests/test_bench_budget)
 SERVE_SCHEMA_KEYS = (
@@ -995,6 +1097,17 @@ def _validate_north_star_aux(ns: dict):
     detail["rank_grad"] = ("measured" if isinstance(rg, dict)
                            and "ns_per_doc" in rg else
                            ("pending-capture" if good else "invalid"))
+    ok = ok and good
+    # device_attribution (ISSUE 10): every future capture is expected
+    # to carry attribution columns — a measured fractions dict or an
+    # explicit pending-capture spec
+    datt = ns.get("device_attribution")
+    measured_att = isinstance(datt, dict) and "device_frac" in datt
+    good = measured_att or (isinstance(datt, dict)
+                            and datt.get("status") == "pending-capture")
+    detail["device_attribution"] = ("measured" if measured_att else
+                                    ("pending-capture" if good
+                                     else "invalid"))
     return ok and good, detail
 
 
@@ -1107,6 +1220,46 @@ def dryrun_main():
     except Exception as exc:        # noqa: BLE001 - reported on the line
         line["serve_schema_ok"] = False
         line["serve_leg"] = f"failed: {type(exc).__name__}: {exc}"
+    # device-time attribution gate (ISSUE 10): the REAL leg at toy
+    # shape on CPU — windowed capture, parse, schema — with the
+    # acceptance floor: >=90% of captured device time attributes to
+    # named spans, host_gap and per-program cost populated
+    try:
+        att = attribution_leg(dryrun=True)
+        missing = [k for k in ATTRIBUTION_SCHEMA_KEYS if k not in att]
+        line.update(att)
+        line["attribution_schema_ok"] = bool(
+            not missing
+            and att["attribution_device_time_s"] > 0
+            and att["attribution_coverage"] is not None
+            and att["attribution_coverage"] >= 0.90
+            and att["attribution_spans"]
+            and att["attribution_host_gap_frac"] is not None
+            and att["attribution_dispatch_gap_mean_s"] is not None
+            and any(r.get("flops") for r in
+                    att["attribution_cost_programs"]))
+        if missing:
+            line["attribution_schema_missing"] = missing
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["attribution_schema_ok"] = False
+        line["attribution_leg"] = f"failed: {type(exc).__name__}: {exc}"
+    # perf-ledger gate (ISSUE 10): every committed BENCH_r*.json must
+    # load into the cross-round trend table (unparsed driver-timeout
+    # rounds stay visible, never crash the ledger), and the newest
+    # parsed round must not regress >10% vs the best prior round
+    try:
+        from tools.perf_ledger import check_regressions, load_history
+        hist = load_history(os.path.dirname(os.path.abspath(__file__)))
+        line["perf_ledger_rounds"] = [h["round"] for h in hist]
+        line["perf_ledger_parsed_rounds"] = [
+            h["round"] for h in hist if h["parsed"]]
+        regs = check_regressions(hist)
+        if regs:
+            line["perf_ledger_regressions"] = regs
+        line["perf_ledger_ok"] = bool(hist) and not regs
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["perf_ledger_ok"] = False
+        line["perf_ledger_error"] = f"{type(exc).__name__}: {exc}"
     # per-leg peak_hbm_bytes (ISSUE 8): every leg the dryrun emitted
     # carries the field — a positive int where the backend exposes
     # allocator stats, null + peak_hbm_reason where it doesn't (CPU) —
@@ -1347,6 +1500,19 @@ def main():
         if rg is not None:
             line.update(rg)
             line["partial"] = "headline-1M+rank-grad"
+            _emit(line)
+
+    # device-time attribution leg (ISSUE 10): a small profiled train —
+    # device/host-gap/collective fractions, top programs by device
+    # time, cost-model FLOPs/bytes — on every artifact, so the perf
+    # ledger can trend WHERE the time goes round over round, not just
+    # how much.  Cheap, separate from the timed legs, emitted
+    # incrementally so a driver deadline can't erase it.
+    if os.environ.get("BENCH_ATTRIBUTION", "1") != "0":
+        att = _leg(line, "attribution", attribution_leg)
+        if att is not None:
+            line.update(att)
+            line["partial"] = "headline-1M+attribution"
             _emit(line)
 
     if os.environ.get("BENCH_FULL", "1") != "0":

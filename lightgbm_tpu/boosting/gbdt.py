@@ -217,6 +217,11 @@ class GBDT:
         # TOTAL target after resume_from_snapshot (the dead run's
         # target), vs "additional rounds" for continued training
         self._resumed = False
+        # device-time attribution session (obs/profiler.py) while
+        # train() runs under LGBM_TPU_PROFILE; dispatch-gap timestamp
+        # for the ROADMAP item-1 host-latency counters
+        self._profiler = None
+        self._t_dispatch_ret: Optional[float] = None
 
         if train_set is not None:
             self._init_train(train_set)
@@ -676,8 +681,10 @@ class GBDT:
         iter_trees = []
         for k in range(K):
             fmask = self._feature_mask(self.iter * K + k)
+            self._gap_dispatch_start()
             with tag("tree") as done:
                 bt = self._build_tree(grad[:, k], hess[:, k], bag, fmask)
+                self._gap_dispatch_done()
                 done(bt.num_leaves)
             bt = self._renew_leaves(bt, k)
             # stump => zero contribution (reference skips UpdateScore and
@@ -1293,6 +1300,7 @@ class GBDT:
             fn = self._block_fn(L)
             if compiling:
                 counter_add("gbdt.block_compiles")
+            self._gap_dispatch_start()
             with obs_span("gbdt.block_compile" if compiling
                           else "gbdt.block", iters=nb), \
                     tag("block") as tdone:
@@ -1313,8 +1321,19 @@ class GBDT:
                     fn = self._block_fn(self._pick_block_len(nb))
                     (self.scores, vscores), trees = self._dispatch_retry(
                         fn, *args)
+                self._gap_dispatch_done()
                 self._valid_scores = list(vscores)
                 tdone(trees.num_leaves)
+            if compiling:
+                # static XLA cost model (gated on LGBM_TPU_PROFILE /
+                # LGBM_TPU_COST_MODEL: one extra lower+compile per
+                # program length, acceptable in an explicit profiling
+                # run) — FLOPs/bytes per block program for the
+                # device_attribution roofline columns
+                from ..obs import profiler as obs_profiler
+                obs_profiler.record_program_cost(
+                    f"gbdt.block[{L}]", fn, args,
+                    module_hint="jit_block", iters=int(nb))
             # init-score bias rides the pending entry and is baked into
             # the first K host trees at flush (no separate per-iteration
             # bias-bake dispatch, which cost a whole extra XLA program)
@@ -1371,22 +1390,63 @@ class GBDT:
         first window is warmup, everything after must hit the trace
         cache — the report lands in the telemetry summary's
         ``trace_contract`` section (background block-length upgrades
-        are counted separately, not as violations)."""
+        are counted separately, not as violations).
+
+        Under ``LGBM_TPU_PROFILE=<dir>`` the loop additionally runs a
+        WINDOWED device-time capture (``obs/profiler.py``): the first
+        window is warmup, the next N windows are profiled, and the
+        parsed per-span device-time / host-gap / roofline report lands
+        in the summary's ``device_attribution`` section mid-train."""
         from ..obs.mem_contract import maybe_watermark
+        from ..obs.profiler import maybe_profile
         from ..obs.trace_contract import maybe_track
         with obs_span("gbdt.train"), maybe_track() as tracker, \
-                maybe_watermark("gbdt") as wm:
+                maybe_watermark("gbdt") as wm, \
+                maybe_profile("gbdt", sync=self._sync_pending) as prof:
             self._trace_tracker = tracker
             self._mem_watermark = wm
+            self._profiler = prof
             try:
                 self._train(num_iterations, callbacks)
             finally:
                 self._trace_tracker = None
                 self._mem_watermark = None
+                self._profiler = None
         from ..obs import enabled as obs_enabled, gauge_set
         if obs_enabled():
             gauge_set("gbdt.iterations", int(self.iter))
             gauge_set("gbdt.num_trees", int(self._num_models()))
+            from ..obs import summary as obs_summary
+            c = obs_summary()["counters"]
+            gaps = c.get("gbdt.dispatch_gaps", 0)
+            if gaps:
+                # the ROADMAP item-1 host-latency signal, live on EVERY
+                # telemetry run — profiling off included
+                gauge_set("gbdt.dispatch_gap_mean_s",
+                          c.get("gbdt.dispatch_gap_s", 0.0) / gaps)
+
+    def _sync_pending(self) -> None:
+        """Block on in-flight device work (profile-capture hygiene:
+        a stopped trace must contain the captured windows' ops).  Host
+        code, not traced — the sync is the point."""
+        jax.block_until_ready(self.scores)
+
+    # -- dispatch-gap accounting (ROADMAP item 1) -----------------------
+    def _gap_dispatch_start(self) -> None:
+        """Called right before a training dispatch: the time since the
+        PREVIOUS dispatch returned is host gap — objective/bookkeeping
+        work the device spends idle waiting on.  Summed into the
+        ``gbdt.dispatch_gap_s`` counter (mean gauge at end of train),
+        so the per-iteration host-latency signal exists on every
+        telemetry run, not just profiled ones."""
+        from ..obs import enabled as obs_enabled
+        t = self._t_dispatch_ret
+        if t is not None and obs_enabled():
+            counter_add("gbdt.dispatch_gap_s", time.perf_counter() - t)
+            counter_add("gbdt.dispatch_gaps")
+
+    def _gap_dispatch_done(self) -> None:
+        self._t_dispatch_ret = time.perf_counter()
 
     def _train(self, num_iterations: Optional[int],
                callbacks: Sequence) -> None:
@@ -1423,6 +1483,13 @@ class GBDT:
                 window = min(window, eval_freq - (it % eval_freq))
             if c.snapshot_freq > 0:
                 window = min(window, c.snapshot_freq - (it % c.snapshot_freq))
+            prof = getattr(self, "_profiler", None)
+            if prof is not None:
+                # live device-time capture: bound windows so the
+                # warmup/capture boundaries fall every few iterations
+                # (a fused 500-iteration window would never hand the
+                # profiler a post-warmup boundary to start at)
+                window = prof.clamp_window(window)
             t0 = time.time()
             if self._can_block():
                 # window == 1 (per-iteration eval cadence, the default
@@ -1443,6 +1510,14 @@ class GBDT:
             tracker = getattr(self, "_trace_tracker", None)
             if tracker is not None:
                 tracker.mark_steady()
+            if prof is not None:
+                # window boundary: warmup -> start capture -> after N
+                # windows stop + parse + attach device_attribution.
+                # A boundary that did heavy profiler work (trace
+                # start/stop+parse) must not bill itself to the next
+                # window's dispatch-gap counter
+                if prof.window(it=int(it)):
+                    self._t_dispatch_ret = None
             # mem.leak fault: grow a module-lifetime sink by one fresh
             # device buffer per window (the leak class the watermark
             # contract catches; it != 0 defeats constant folding)

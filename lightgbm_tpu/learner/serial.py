@@ -807,7 +807,13 @@ def make_phases_driver(data: DeviceData,
     from ..obs import span as obs_span
 
     def build(grad, hess, bag_mask=None, feature_mask=None) -> BuiltTree:
-        state = init_jit(grad, hess, bag_mask)
+        with obs_span("tree.init"), tag("tree:init") as done:
+            # root statistics + state zero-fill: previously the one
+            # unattributed dispatch of the phase-timed path (the
+            # device-time attribution parser joins XLA ops to named
+            # spans — an unnamed dispatch is a coverage hole)
+            state = init_jit(grad, hess, bag_mask)
+            done(state.leaf_sum_grad)
         while True:
             with obs_span("tree.route"), tag("tree:route") as done:
                 leaf2 = route_jit(state)

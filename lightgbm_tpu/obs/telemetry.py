@@ -53,7 +53,7 @@ from typing import Any, Dict, IO, Optional
 __all__ = [
     "enabled", "enable", "disable", "reset", "span", "counter_add",
     "gauge_set", "event", "summary", "merged_summary", "write_summary",
-    "trace_path", "set_section",
+    "trace_path", "set_section", "set_annotator",
 ]
 
 _lock = threading.RLock()
@@ -74,6 +74,18 @@ _events: Dict[str, int] = {}
 # stored even while telemetry is disabled — a contract check the user
 # explicitly enabled must not vanish because tracing is off
 _sections: Dict[str, Any] = {}
+# span annotator hook (obs/profiler.py): while a device-time capture
+# is live, every span ALSO enters a jax.profiler.TraceAnnotation of
+# the same name, so XLA ops attribute to the span tree.  None (the
+# default) costs one module-attribute read per span
+_annotator = None
+
+
+def set_annotator(fn) -> None:
+    """Install/remove the per-span annotation factory (``fn(name)`` ->
+    context manager).  Owned by ``obs/profiler.py``."""
+    global _annotator
+    _annotator = fn
 
 
 def _rank_world():
@@ -131,11 +143,12 @@ def reset() -> None:
     """Clear the run summary and forget any requested trace (tests).
     Also rewinds the collective flight recorder — a fresh run must not
     inherit the previous run's schedule digest."""
-    global _trace_requested, _held
+    global _trace_requested, _held, _annotator
     with _lock:
         disable()
         _trace_requested = None
         _held = None
+        _annotator = None
         _spans.clear()
         _counters.clear()
         _gauges.clear()
@@ -145,6 +158,8 @@ def reset() -> None:
             _tls.stack = []
     from . import flight_recorder
     flight_recorder.reset()
+    from . import profiler
+    profiler.reset()
 
 
 def trace_path() -> Optional[str]:
@@ -249,7 +264,7 @@ _NOOP_SPAN = _NoopSpan()
 
 
 class _Span:
-    __slots__ = ("name", "attrs", "t0", "ts", "depth")
+    __slots__ = ("name", "attrs", "t0", "ts", "depth", "ann")
 
     def __init__(self, name: str, attrs: Dict[str, Any]):
         self.name = name
@@ -261,12 +276,30 @@ class _Span:
             stack = _tls.stack = []
         self.depth = len(stack)
         stack.append(self.name)
+        ann = _annotator
+        if ann is not None:
+            try:
+                self.ann = ann(self.name)
+                self.ann.__enter__()
+            # tpulint: disable=TPL006 -- annotation is best-effort; a
+            # profiler hiccup must not take the training span down
+            except Exception:           # noqa: BLE001
+                self.ann = None
+        else:
+            self.ann = None
         self.ts = time.time()
         self.t0 = time.perf_counter()
         return self.attrs
 
     def __exit__(self, *exc):
         dur = time.perf_counter() - self.t0
+        if self.ann is not None:
+            try:
+                self.ann.__exit__(*exc)
+            # tpulint: disable=TPL006 -- annotation close is best-effort
+            except Exception:           # noqa: BLE001
+                pass
+            self.ann = None
         stack = _tls.stack
         parent = ""
         if stack and stack[-1] is self.name:

@@ -81,9 +81,13 @@ from ..ops.split import (K_MIN_SCORE, SplitParams, SplitResult,
 def _psum(axis):
     def psum_fn(x):
         # trace-time fingerprint: each process traces its own program,
-        # so THIS is where a rank-divergent schedule would be born
+        # so THIS is where a rank-divergent schedule would be born.
+        # The named_scope stamps the flight-recorder site name into the
+        # HLO op metadata, so profiler captures and HLO dumps name the
+        # collective by the same site the runtime digest uses
         _fr_record("parallel.learners.hist_psum", "psum", axis, x)
-        return jax.lax.psum(x, axis)
+        with jax.named_scope("collective.hist_psum"):
+            return jax.lax.psum(x, axis)
     return psum_fn
 
 
@@ -92,8 +96,9 @@ def _sync_global_best(best: SplitResult, axis: str) -> SplitResult:
     ``SyncUpGlobalBestSplit`` reducer (`parallel_tree_learner.h:184-207`)."""
     _fr_record("parallel.learners.sync_global_best", "all_gather", axis,
                best.gain)
-    gathered = jax.tree.map(
-        lambda a: jax.lax.all_gather(a, axis), best)      # [S, 2A, ...]
+    with jax.named_scope("collective.sync_global_best"):
+        gathered = jax.tree.map(
+            lambda a: jax.lax.all_gather(a, axis), best)  # [S, 2A, ...]
     win = jnp.argmax(gathered.gain, axis=0)               # [2A]
 
     def pick(a):
@@ -249,10 +254,12 @@ def make_voting_parallel_strategy(data: DeviceData, grad, hess,
             local_vals, 0.0)
         _fr_record("parallel.learners.voting.vote_gather", "all_gather",
                    axis, local_top)
-        g_top = jax.lax.all_gather(local_top, axis)      # [S, 2A, k] i32
+        with jax.named_scope("collective.vote_gather"):
+            g_top = jax.lax.all_gather(local_top, axis)  # [S, 2A, k] i32
         _fr_record("parallel.learners.voting.vote_gather", "all_gather",
                    axis, local_vals)
-        g_val = jax.lax.all_gather(local_vals, axis)     # [S, 2A, k] f32
+        with jax.named_scope("collective.vote_gather"):
+            g_val = jax.lax.all_gather(local_vals, axis)  # [S, 2A, k] f32
         # GlobalVoting: weighted-gain vote tally, scattered LOCALLY
         rows = jnp.arange(local_gain.shape[0])[None, :, None]
         votes = jnp.zeros(local_gain.shape).at[rows, g_top].add(g_val)
@@ -262,7 +269,8 @@ def make_voting_parallel_strategy(data: DeviceData, grad, hess,
             grid, sel_feats[:, :, None, None], axis=1)   # [2A, k2, B, 3]
         _fr_record("parallel.learners.voting.sel_psum", "psum", axis,
                    sel_grid)
-        sel_grid = jax.lax.psum(sel_grid, axis)
+        with jax.named_scope("collective.sel_psum"):
+            sel_grid = jax.lax.psum(sel_grid, axis)
         nb = data.num_bins[sel_feats]
         mt = data.missing_types[sel_feats]
         db = data.default_bins[sel_feats]

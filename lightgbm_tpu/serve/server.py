@@ -43,6 +43,7 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..obs import counter_add, event, span, set_section
+from ..obs import profiler as obs_profiler
 from ..obs.trace_contract import CompileTracker, contract_enabled
 from ..utils.faults import fault_point
 from ..utils.log import log_info, log_warning
@@ -107,6 +108,9 @@ class PredictionServer:
         self._n_padded = 0
         self._latency: Dict[int, List[float]] = {}
         self._carry: List[_Request] = []    # worker-only: batch overflow
+        # worker-only: previous batch dispatch's return time, for the
+        # serve.dispatch_gap_s host-latency counter
+        self._t_last_dispatch: Optional[float] = None
         # the runtime zero-recompile proof: a live tracker when the
         # trace contract is armed (track_threads=False — the worker
         # thread's compiles ARE the contract here, unlike training's
@@ -269,7 +273,20 @@ class PredictionServer:
         if bucket != n:
             X = np.concatenate(
                 [X, np.zeros((bucket - n,) + X.shape[1:], X.dtype)])
-        with span("serve.batch") as s:
+        # dispatch gap: device idle between consecutive batch
+        # dispatches (queue wait + coalescing + padding on the host) —
+        # the serving-side analog of the training loop's
+        # gbdt.dispatch_gap_s host-latency counter
+        t_prev = self._t_last_dispatch
+        if t_prev is not None:
+            counter_add("serve.dispatch_gap_s",
+                        time.perf_counter() - t_prev)
+            counter_add("serve.dispatch_gaps")
+        # step marker: while a device-time capture is live each batch
+        # is a profiler step, so per-batch device cost reads directly
+        # off the trace (no-op otherwise)
+        with span("serve.batch") as s, \
+                obs_profiler.step("serve.batch", self._n_batches):
             s["rows"] = n
             s["bucket"] = bucket
             s["requests"] = len(batch)
@@ -286,6 +303,7 @@ class PredictionServer:
                 return
         out = np.asarray(out)[:n]
         now = time.perf_counter()
+        self._t_last_dispatch = now
         with self._lock:
             self._n_batches += 1
             self._n_rows += n

@@ -92,12 +92,12 @@ def test_deadline_skips_aux_legs_with_markers(bench_run):
     assert "partial" not in final           # the complete line
     assert final["value"] > 0               # headline retained
     for leg in ("serve", "valid", "bin255", "rank", "rank63", "multichip",
-                "split_finder", "rank_grad"):
+                "split_finder", "rank_grad", "attribution"):
         assert final.get(f"{leg}_leg") == "skipped: budget", final
     assert final.get("real_data") == "skipped: budget"
     assert set(final.get("legs_skipped", [])) >= {
         "serve", "valid", "bin255", "rank", "rank63", "multichip",
-        "split_finder", "rank_grad"}
+        "split_finder", "rank_grad", "attribution"}
     # an explicit skip is not a failure: no legs_failed / hard-failed
     assert "legs_failed" not in final
     assert "legs_hard_failed" not in final
@@ -195,6 +195,30 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     for key in ("split_finder", "rank_grad"):
         assert out["north_star_aux_detail"][key] in (
             "measured", "pending-capture"), out["north_star_aux_detail"]
+    # device-time attribution gate (ISSUE 10): the REAL leg ran at toy
+    # shape — windowed LGBM_TPU_PROFILE capture, parsed, >= 90% of the
+    # captured device time attributed to named spans, host-gap and
+    # per-program cost-model FLOPs/bytes populated
+    assert out["attribution_schema_ok"] is True, out.get(
+        "attribution_leg", out.get("attribution_schema_missing"))
+    from bench import ATTRIBUTION_SCHEMA_KEYS
+    for key in ATTRIBUTION_SCHEMA_KEYS:
+        assert key in out, key
+    assert out["attribution_device_time_s"] > 0
+    assert out["attribution_coverage"] >= 0.90
+    assert out["attribution_spans"]
+    assert out["attribution_host_gap_frac"] is not None
+    assert out["attribution_dispatch_gap_mean_s"] is not None
+    assert any(r["flops"] for r in out["attribution_cost_programs"])
+    assert out["north_star_aux_detail"]["device_attribution"] in (
+        "measured", "pending-capture")
+    # perf-ledger gate (ISSUE 10): the cross-round trend table loads
+    # every committed BENCH_r*.json (unparsed rounds visible) and the
+    # newest parsed round does not regress >10% vs the best prior
+    assert out["perf_ledger_ok"] is True, out.get(
+        "perf_ledger_error", out.get("perf_ledger_regressions"))
+    assert set(out["perf_ledger_rounds"]) >= {1, 2, 3, 4, 5}
+    assert out["perf_ledger_parsed_rounds"], out
     # per-leg memory column (ISSUE 8): every dryrun leg carries
     # peak_hbm_bytes — int > 0 with allocator stats, else null + reason
     assert out["peak_hbm_schema_ok"] is True, out
@@ -233,6 +257,7 @@ def test_gate_bearing_hard_failure_zeroes_headline():
            "BENCH_LEAVES": "7", "BENCH_BIN": "15",
            "BENCH_FULL": "0", "BENCH_255": "0", "BENCH_RANK": "0",
            "BENCH_WAVES": "0", "BENCH_SERVE": "0",
+           "BENCH_ATTRIBUTION": "0",   # this test gates the valid leg
            "BENCH_FORCE_FAIL": "valid"}
     env.pop("XLA_FLAGS", None)
     env.pop("BENCH_DATA", None)
@@ -248,18 +273,24 @@ def test_gate_bearing_hard_failure_zeroes_headline():
     assert final["value"] > 0          # the headline NUMBER is retained
 
 
-def test_split_finder_rank_grad_survive_midrun_kill():
-    """ISSUE 9 satellite: the split_finder and rank_grad tables are
-    emitted INCREMENTALLY (each as its own partial line, right after
-    the headline) — a hard kill (SIGKILL, the driver-timeout class)
-    immediately after the rank_grad checkpoint must leave a last
-    parseable line that carries BOTH tables."""
+def test_split_finder_rank_grad_attribution_survive_midrun_kill():
+    """ISSUE 9/10 satellite: the split_finder, rank_grad, and
+    device-time attribution tables are emitted INCREMENTALLY (each as
+    its own partial line, right after the headline) — a hard kill
+    (SIGKILL, the driver-timeout class) immediately after the
+    attribution checkpoint must leave a last parseable line that
+    carries ALL of them."""
     import time
     env = {**os.environ, "JAX_PLATFORMS": "cpu",
            "PYTHONPATH": REPO + os.pathsep + os.environ.get(
                "PYTHONPATH", ""),
            "BENCH_ROWS": "2000", "BENCH_ITERS": "2",
-           "BENCH_LEAVES": "7", "BENCH_BIN": "15", "BENCH_FULL": "0"}
+           "BENCH_LEAVES": "7", "BENCH_BIN": "15", "BENCH_FULL": "0",
+           # toy attribution-leg shape: the profiled capture + parse
+           # must stay seconds, not the real-leg 100k-row minutes
+           "BENCH_ATTR_ROWS": "1500", "BENCH_ATTR_ITERS": "6",
+           "BENCH_ATTR_FEATURES": "5", "BENCH_ATTR_LEAVES": "7",
+           "BENCH_ATTR_BIN": "15"}
     env.pop("XLA_FLAGS", None)
     env.pop("BENCH_DATA", None)
     env.pop("BENCH_DEADLINE_S", None)
@@ -271,7 +302,8 @@ def test_split_finder_rank_grad_survive_midrun_kill():
     try:
         for ln in proc.stdout:
             lines.append(ln)
-            if '"headline-1M+rank-grad"' in ln or time.time() > deadline:
+            if '"headline-1M+attribution"' in ln \
+                    or time.time() > deadline:
                 break
     finally:
         proc.kill()
@@ -279,8 +311,8 @@ def test_split_finder_rank_grad_survive_midrun_kill():
     parsed = _parse_lines("".join(lines))
     assert parsed, "".join(lines)
     last = parsed[-1]
-    assert last.get("partial") == "headline-1M+rank-grad", last
-    # the kill happened mid-run; the artifact already carries both
+    assert last.get("partial") == "headline-1M+attribution", last
+    # the kill happened mid-run; the artifact already carries all three
     assert last["value"] > 0
     table = last["split_finder"]
     assert {(r["leaves"], r["max_bin"]) for r in table} == {
@@ -289,6 +321,11 @@ def test_split_finder_rank_grad_survive_midrun_kill():
                and r["full_us_per_wave"] > 0 for r in table)
     assert last["rank_grad_ns_per_doc"] > 0
     assert len(last["rank_grad_bucket_spans"]) > 0
+    # attribution (ISSUE 10): captured, parsed, on the artifact before
+    # the kill — deadline/SIGKILL-survivable like the PR 9 tables
+    assert last["attribution_device_time_s"] > 0
+    assert last["attribution_coverage"] >= 0.90
+    assert last["attribution_spans"], last
 
 
 def test_auc_gate_tightened_beyond_085(bench_run):

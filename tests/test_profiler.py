@@ -1,0 +1,222 @@
+"""Device-time attribution layer tests (obs/profiler.py + chip_specs).
+
+The ISSUE 10 acceptance contract: a CPU ``LGBM_TPU_PROFILE`` capture
+of a small train yields a ``device_attribution`` summary section whose
+per-span table accounts for >= 90% of measured block device time, with
+``host_gap_s`` and per-program ``cost_analysis`` FLOPs/bytes
+populated; the parser is unit-tested against a committed miniature
+trace fixture; the dispatch-gap host-latency counters exist even with
+profiling OFF.
+"""
+import glob
+import json
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import chip_specs, profiler
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "data",
+                       "mini_capture.trace.json.gz")
+
+
+@pytest.fixture(autouse=True)
+def _clean_obs(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_PROFILE", raising=False)
+    obs.reset()
+    yield
+    obs.reset()
+
+
+def _small_data(n=300, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# parser unit tests on the committed miniature fixture
+# ---------------------------------------------------------------------------
+def test_fixture_parse_classification():
+    parsed = profiler.parse_capture(FIXTURE)
+    # runtime internals ($-frames, PjitFunction) are ignored; our three
+    # dotted annotations and four hlo ops survive
+    assert [a["name"] for a in parsed["annotations"]] == [
+        "gbdt.block", "tree.hist", "gbdt.iteration"]
+    assert [o["name"] for o in parsed["ops"]] == [
+        "fusion.1", "dot.2", "add.3", "all-reduce.4"]
+    # chrome-trace us -> seconds
+    assert parsed["annotations"][0]["ts"] == pytest.approx(100e-6)
+    assert parsed["annotations"][0]["dur"] == pytest.approx(1000e-6)
+
+
+def test_fixture_attribution_table():
+    rep = profiler.attribute(profiler.parse_capture(FIXTURE))
+    # 100+200+50+150 us of device time, all attributed
+    assert rep["device_time_s"] == pytest.approx(500e-6)
+    assert rep["coverage"] == 1.0
+    spans = rep["spans"]
+    # op inside the nested span joins the DEEPEST cover; the async
+    # straggler (runs after every span closed) falls back to the
+    # latest-started annotation
+    assert spans["gbdt.block"]["ops"] == 1
+    assert spans["gbdt.block"]["device_s"] == pytest.approx(200e-6)
+    assert spans["tree.hist"]["ops"] == 2          # fusion.1 + straggler
+    assert spans["tree.hist"]["device_s"] == pytest.approx(150e-6)
+    assert spans["gbdt.iteration"]["device_s"] == pytest.approx(150e-6)
+    # collective classification by op-name family
+    assert rep["collective_s"] == pytest.approx(150e-6)
+    assert rep["collective_frac"] == pytest.approx(0.3)
+    # host gap: 1400us of window wall minus 450us of in-window busy
+    assert rep["window_wall_s"] == pytest.approx(1400e-6)
+    assert rep["host_gap_s"] == pytest.approx(950e-6)
+    # per-program totals
+    assert rep["programs"]["jit_block"] == pytest.approx(350e-6)
+    assert rep["programs"]["jit_dist"] == pytest.approx(150e-6)
+    assert rep["top_programs"][0][0] == "jit_block"
+
+
+def test_finalize_report_error_path():
+    rep = profiler.finalize_report("/nonexistent/capture/dir")
+    assert "error" in rep and "FileNotFoundError" in rep["error"]
+
+
+# ---------------------------------------------------------------------------
+# chip specs / roofline
+# ---------------------------------------------------------------------------
+def test_peak_table_known_kinds():
+    v5e = chip_specs.peaks_for("TPU v5e")
+    assert v5e["flops_per_s"] == pytest.approx(197e12)
+    assert v5e["hbm_bytes_per_s"] == pytest.approx(819e9)
+    v5p = chip_specs.peaks_for("TPU v5p")
+    assert v5p["flops_per_s"] > v5e["flops_per_s"]
+    cpu = chip_specs.peaks_for("cpu")
+    assert cpu.get("sentinel") is True
+    unk = chip_specs.peaks_for("quantum-banana-9000")
+    assert unk["flops_per_s"] is None
+
+
+def test_roofline_bound_verdicts():
+    peaks = {"flops_per_s": 100e12, "hbm_bytes_per_s": 1e12}
+    # 80% of peak flops, low bw -> compute-bound
+    r = chip_specs.roofline(80e12, 1e11, 1.0, peaks)
+    assert r["bound"] == "compute" and r["pct_peak_flops"] == 80.0
+    # 80% of peak bw, low flops -> memory-bound
+    r = chip_specs.roofline(1e12, 0.8e12, 1.0, peaks)
+    assert r["bound"] == "memory" and r["pct_peak_bw"] == 80.0
+    # both tiny -> the device is starved: host-bound
+    r = chip_specs.roofline(1e9, 1e8, 1.0, peaks)
+    assert r["bound"] == "host"
+    # static-only verdict (no measured time): AI vs the ridge point
+    r = chip_specs.roofline(1e12, 1e9, None, peaks)
+    assert r["ridge_flops_per_byte"] == 100.0
+    assert r["arith_intensity"] == 1000.0 and r["bound"] == "compute"
+    r = chip_specs.roofline(1e9, 1e9, None, peaks)
+    assert r["bound"] == "memory"
+
+
+# ---------------------------------------------------------------------------
+# the acceptance capture: profiled 2-iteration CPU train
+# ---------------------------------------------------------------------------
+def test_profiled_two_iteration_train(tmp_path, monkeypatch):
+    cap = str(tmp_path / "cap")
+    monkeypatch.setenv("LGBM_TPU_PROFILE", cap)
+    # 1-iteration windows: iteration 0 is warmup, iteration 1 is the
+    # captured window — the ISSUE's "profiled 2-iteration train"
+    monkeypatch.setenv("LGBM_TPU_PROFILE_ITERS", "1")
+    monkeypatch.setenv("LGBM_TPU_PROFILE_WINDOWS", "1")
+    X, y = _small_data()
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 4, "max_bin": 15,
+               "verbose": -1}, ds, num_boost_round=2)
+    s = obs.summary()
+    da = s.get("device_attribution")
+    assert da and not da.get("error"), da
+    # parseable with real content
+    assert da["device_time_s"] > 0 and da["ops"] > 0
+    assert da["windows"] == 1 and da["window_iters"] == 1
+    # >= 90% of measured block device time attributed to NAMED spans
+    assert da["coverage"] >= 0.90, da
+    spans = da["spans"]
+    assert "gbdt.block" in spans or "gbdt.block_compile" in spans, spans
+    named_total = sum(v["device_s"] for v in spans.values())
+    assert named_total >= 0.90 * da["device_time_s"]
+    # host gap populated (>= 0; CPU executes near-synchronously)
+    assert da["host_gap_s"] >= 0.0 and da["window_wall_s"] > 0
+    # cost model: per-program FLOPs/bytes recorded at block compile
+    cost = s.get("xla_cost")
+    assert cost, "xla_cost section missing"
+    blocks = [v for k, v in cost.items() if k.startswith("gbdt.block")]
+    assert blocks and blocks[0]["flops"] > 0
+    assert blocks[0]["bytes_accessed"] > 0
+    # ...and joined into roofline rows in the report
+    rows = da["cost_model"]["programs"]
+    assert any(r["flops"] and r["bound"] for r in rows), rows
+    assert da["cost_model"]["peaks"].get("sentinel") is True  # CPU
+    # the capture actually hit disk (an xprof-able artifact remains)
+    assert glob.glob(os.path.join(cap, "plugins", "profile", "*", "*"))
+    # and the report is JSON-serializable (it rides BENCH artifacts)
+    assert json.loads(json.dumps(da)) == da
+
+
+def test_unprofiled_train_has_no_section():
+    X, y = _small_data()
+    ds = lgb.Dataset(X, label=y)
+    obs.enable()
+    lgb.train({"objective": "binary", "num_leaves": 4, "max_bin": 15,
+               "verbose": -1}, ds, num_boost_round=2)
+    assert "device_attribution" not in obs.summary()
+
+
+# ---------------------------------------------------------------------------
+# dispatch-gap satellite: the host-latency signal with profiling OFF
+# ---------------------------------------------------------------------------
+def test_dispatch_gap_counters_without_profiling(monkeypatch):
+    # cap blocks at 2 iterations so a 6-iteration train needs >= 3
+    # dispatches -> >= 2 measurable inter-dispatch gaps
+    monkeypatch.setenv("LGBM_TPU_BLOCK_CAP", "2")
+    X, y = _small_data()
+    ds = lgb.Dataset(X, label=y)
+    obs.enable()
+    lgb.train({"objective": "binary", "num_leaves": 4, "max_bin": 15,
+               "verbose": -1}, ds, num_boost_round=6)
+    s = obs.summary()
+    assert s["counters"].get("gbdt.dispatch_gaps", 0) >= 2
+    assert s["counters"]["gbdt.dispatch_gap_s"] >= 0.0
+    mean = s["gauges"].get("gbdt.dispatch_gap_mean_s")
+    assert mean is not None and mean >= 0.0
+    # profiling stayed off: no attribution section rode along
+    assert "device_attribution" not in s
+
+
+# ---------------------------------------------------------------------------
+# report rendering + capture CLI plumbing
+# ---------------------------------------------------------------------------
+def test_perf_report_renders_fixture(capsys):
+    import sys
+    sys.path.insert(0, REPO)
+    from tools.perf_report import render
+    rep = profiler.finalize_report(FIXTURE)
+    render(rep)
+    out = capsys.readouterr().out
+    assert "gbdt.block" in out and "tree.hist" in out
+    assert "jit_block" in out
+    assert "host gap" in out
+
+
+def test_find_trace_file_layouts(tmp_path):
+    # capture-root layout (what start_trace writes)
+    sess = tmp_path / "plugins" / "profile" / "2026_01_01_00_00_00"
+    sess.mkdir(parents=True)
+    f = sess / "host.trace.json.gz"
+    f.write_bytes(b"")
+    assert profiler.find_trace_file(str(tmp_path)) == str(f)
+    # direct file
+    assert profiler.find_trace_file(str(f)) == str(f)
+    # nothing there
+    assert profiler.find_trace_file(str(tmp_path / "empty")) is None

@@ -1,0 +1,124 @@
+#!/usr/bin/env python
+"""Render a device-time attribution report from a profiler capture.
+
+Usage::
+
+    python tools/perf_report.py <capture-dir | trace.json.gz | summary.json>
+
+Accepts, in order of preference:
+
+* a capture directory written under ``LGBM_TPU_PROFILE=<dir>`` (or by
+  ``tools/profile_capture.py``) — the newest
+  ``plugins/profile/<ts>/*.trace.json.gz`` session is parsed;
+* a chrome-trace ``*.trace.json(.gz)`` file directly;
+* a telemetry summary JSON (``<trace>.summary.json`` or any file whose
+  top-level object carries a ``device_attribution`` section) — renders
+  the already-parsed section without re-reading the trace.
+
+Prints the per-span device-time table (the share column is of total
+attributed device time), the host-gap / collective accounting, the
+top programs by device time, and — when the capture ran with the XLA
+cost model (``LGBM_TPU_PROFILE`` implies it) — the per-program
+roofline columns: FLOPs, bytes accessed, arithmetic intensity,
+%-of-peak FLOPs/BW against the ``obs/chip_specs.py`` table, and the
+compute / memory / host ``bound`` verdict.
+"""
+import json
+import os
+import sys
+
+
+def _load_summary_section(path):
+    """-> the device_attribution dict if ``path`` is a summary JSON
+    carrying one, else None."""
+    if not os.path.isfile(path):
+        return None
+    try:
+        with open(path, encoding="utf-8") as f:
+            head = f.read(1)
+            if head != "{":
+                return None
+            f.seek(0)
+            data = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if isinstance(data, dict):
+        if "device_attribution" in data:
+            return data["device_attribution"]
+        if "spans" in data and "device_time_s" in data:
+            return data                 # a bare attribution dict
+    return None
+
+
+def render(report, out=None):
+    """Pretty-print one attribution report (the dict the profiler
+    attaches as the ``device_attribution`` summary section)."""
+    out = out if out is not None else sys.stdout   # late-bound: capsys
+    p = lambda *a: print(*a, file=out)  # noqa: E731
+    if report.get("error"):
+        p(f"attribution FAILED: {report['error']}  "
+          f"(source: {report.get('source')})")
+        return
+    p(f"capture: {report.get('source')}")
+    dev = report.get("device_time_s") or 0.0
+    p(f"device time: {dev:.6f}s over {report.get('ops', 0)} ops "
+      f"({report.get('annotations', 0)} annotations)  "
+      f"coverage: {report.get('coverage')}")
+    p(f"wall: {report.get('capture_wall_s')}s   device busy: "
+      f"{report.get('device_busy_s')}s   host gap (in windows): "
+      f"{report.get('host_gap_s')}s of {report.get('window_wall_s')}s")
+    p(f"collectives: {report.get('collective_s')}s "
+      f"(frac {report.get('collective_frac')})")
+    spans = report.get("spans") or {}
+    if spans:
+        p(f"\n{'span':<28s} {'ops':>9s} {'device_s':>12s} {'share':>7s}")
+        p("-" * 60)
+        for name, rec in spans.items():
+            share = 100.0 * rec["device_s"] / dev if dev else 0.0
+            p(f"{name:<28s} {rec['ops']:>9d} {rec['device_s']:>12.6f} "
+              f"{share:>6.1f}%")
+    top = report.get("top_programs") or []
+    if top:
+        p("\ntop programs by device time:")
+        for mod, s in top:
+            p(f"  {mod:<40s} {s:>12.6f}s")
+    cm = report.get("cost_model") or {}
+    rows = cm.get("programs") or []
+    if rows:
+        peaks = cm.get("peaks", {})
+        sent = " [SENTINEL peaks]" if peaks.get("sentinel") else ""
+        p(f"\nroofline vs {cm.get('device_kind')}{sent} "
+          f"({peaks.get('source', 'no peak table')}):")
+        p(f"{'program':<22s} {'flops':>12s} {'bytes':>12s} {'AI':>7s} "
+          f"{'%flops':>7s} {'%bw':>7s} {'bound':>8s}")
+        p("-" * 80)
+        for r in rows:
+            ai = r.get("arith_intensity")
+            p(f"{r['program']:<22s} "
+              f"{(r.get('flops') or 0):>12.3e} "
+              f"{(r.get('bytes_accessed') or 0):>12.3e} "
+              f"{(f'{ai:.2f}' if ai is not None else '-'):>7s} "
+              f"{(str(r.get('pct_peak_flops')) or '-'):>7s} "
+              f"{(str(r.get('pct_peak_bw')) or '-'):>7s} "
+              f"{(r.get('bound') or '-'):>8s}")
+
+
+def main(argv):
+    if not argv:
+        print(__doc__)
+        return 1
+    path = argv[0]
+    report = _load_summary_section(path)
+    if report is None:
+        # package-root import dance: let `python tools/perf_report.py`
+        # work without an installed package
+        sys.path.insert(0, os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))))
+        from lightgbm_tpu.obs.profiler import finalize_report
+        report = finalize_report(path)
+    render(report)
+    return 1 if report.get("error") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -1,0 +1,172 @@
+#!/usr/bin/env python
+"""Parameterized device-time capture CLI (obs/profiler.py harness).
+
+Replaces the two throwaway scripts this repo accreted
+(``trace_tree_build.py``: hardcoded 1M x 28f x 63-bin jitted
+build_tree; ``trace_bench_block.py``: hardcoded 1M-row train_block
+with max_bin as a bare argv) with ONE tool over the first-class
+capture layer::
+
+    python tools/profile_capture.py --leg tree  --rows 1000000 \
+        --leaves 255 --max-bin 63 --features 28 --out /tmp/jtrace
+    python tools/profile_capture.py --leg block --max-bin 255
+    python tools/profile_capture.py --leg train --iters 16 --windows 4
+
+Legs:
+
+* ``tree``  — the raw jitted ``build_tree`` program (no boosting loop):
+  warm once, then capture ``--reps`` dispatches.  The phase spans
+  (``tree.route/.hist/.split_find/.update``) only exist on the
+  unfused ``LGBM_TPU_TIMETAG=phases`` path; on the fused path the
+  whole build is one program and the report's per-program table is
+  the signal.
+* ``block`` — a real ``Booster`` driving ``train_block`` (the fused
+  production path): warm, then capture one ``--iters`` block window.
+* ``train`` — the full ``lgb.train`` loop under the same windowed
+  ``LGBM_TPU_PROFILE`` capture a bench run uses (warmup window, then
+  ``--windows`` captured windows of ``LGBM_TPU_PROFILE_ITERS`` each).
+
+Every leg ends by printing the parsed attribution report
+(``tools/perf_report.py`` rendering: per-span device table, host gap,
+top programs, roofline columns) — the capture dir keeps the raw trace
+for xprof/perfetto.
+"""
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import numpy as np  # noqa: E402
+
+
+def _synthetic(n, f, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] - X[:, 2]
+         + rng.normal(size=n) > 0).astype(np.float32)
+    return X, y
+
+
+def leg_tree(args):
+    """Capture --reps dispatches of the raw jitted tree build."""
+    import jax
+    import jax.numpy as jnp
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+    from lightgbm_tpu.io.device import to_device
+    from lightgbm_tpu.learner.serial import GrowthParams, build_tree
+    from lightgbm_tpu.obs import profiler
+    from lightgbm_tpu.ops.pallas_histogram import transpose_bins
+    from lightgbm_tpu.ops.split import SplitParams
+
+    X, y = _synthetic(args.rows, args.features)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": args.max_bin})
+    ds.construct()
+    dd = to_device(ds._constructed)
+    del X
+    params = GrowthParams(num_leaves=args.leaves,
+                          split=SplitParams(min_data_in_leaf=20))
+    rng = np.random.RandomState(0)
+    grad = jnp.asarray(rng.normal(size=args.rows).astype(np.float32))
+    hess = jnp.asarray(
+        rng.uniform(0.1, 0.3, size=args.rows).astype(np.float32))
+    bins_t = jax.jit(transpose_bins)(dd.bins)
+    bt = jax.jit(lambda g, h: build_tree(dd, g, h, params, bins_t=bins_t))
+    r = bt(grad, hess)
+    jax.block_until_ready(r.leaf_value)             # warm: compile
+    profiler.record_program_cost("tree.build", bt, (grad, hess),
+                                 module_hint="jit_")
+    with profiler.capture(
+            args.out,
+            sync=lambda: jax.block_until_ready(r.leaf_value)) as cap:
+        for i in range(args.reps):
+            with obs.span("gbdt.iteration", it=i), \
+                    profiler.step("tree.build", i):
+                r = bt(grad, hess)
+        jax.block_until_ready(r.leaf_value)
+    return cap.report
+
+
+def leg_block(args):
+    """Capture one train_block window on the fused production path."""
+    import jax
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.basic import Booster
+    from lightgbm_tpu.obs import profiler
+
+    X, y = _synthetic(args.rows, args.features)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": args.max_bin})
+    ds.construct()
+    del X
+    params = {"objective": "binary", "num_leaves": args.leaves,
+              "max_bin": args.max_bin, "learning_rate": 0.1,
+              "min_data_in_leaf": 20, "verbose": -1}
+    bst = Booster(params=params, train_set=ds)
+    bst.update()
+    g = bst._gbdt
+    g.train_block(args.iters)                       # warm: compile
+    jax.block_until_ready(g.scores)
+    with profiler.capture(
+            args.out,
+            sync=lambda: jax.block_until_ready(g.scores)) as cap:
+        g.train_block(args.iters)
+        jax.block_until_ready(g.scores)
+    return cap.report
+
+
+def leg_train(args):
+    """The full lgb.train loop under windowed LGBM_TPU_PROFILE capture
+    — exactly what a profiled bench leg records."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu import obs
+
+    os.environ["LGBM_TPU_PROFILE"] = args.out
+    os.environ.setdefault("LGBM_TPU_PROFILE_WINDOWS", str(args.windows))
+    X, y = _synthetic(args.rows, args.features)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": args.max_bin})
+    params = {"objective": "binary", "num_leaves": args.leaves,
+              "max_bin": args.max_bin, "learning_rate": 0.1,
+              "min_data_in_leaf": 20, "verbose": -1}
+    lgb.train(params, ds, num_boost_round=args.iters)
+    return obs.summary().get("device_attribution")
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--leg", choices=("tree", "block", "train"),
+                    default="block")
+    ap.add_argument("--rows", type=int, default=1_000_000)
+    ap.add_argument("--features", type=int, default=28)
+    ap.add_argument("--max-bin", type=int, default=63)
+    ap.add_argument("--leaves", type=int, default=255)
+    ap.add_argument("--iters", type=int, default=4,
+                    help="block/train: boosting iterations")
+    ap.add_argument("--reps", type=int, default=3,
+                    help="tree: captured build dispatches")
+    ap.add_argument("--windows", type=int, default=2,
+                    help="train: captured windows after warmup")
+    ap.add_argument("--out", default="",
+                    help="capture dir (default /tmp/lgbm_profile_<leg>)")
+    args = ap.parse_args(argv)
+    if not args.out:
+        args.out = f"/tmp/lgbm_profile_{args.leg}"
+    os.makedirs(args.out, exist_ok=True)
+    t0 = time.time()
+    report = {"tree": leg_tree, "block": leg_block,
+              "train": leg_train}[args.leg](args)
+    print(f"\ncapture leg={args.leg} rows={args.rows} "
+          f"features={args.features} max_bin={args.max_bin} "
+          f"leaves={args.leaves} took {time.time() - t0:.1f}s "
+          f"-> {args.out}")
+    if report is None:
+        print("no attribution report produced (capture failed to start?)")
+        return 1
+    from tools.perf_report import render
+    render(report)
+    return 1 if report.get("error") else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

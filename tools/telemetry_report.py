@@ -4,6 +4,7 @@
 Usage::
 
     python tools/telemetry_report.py /tmp/run.jsonl [more.jsonl ...]
+    python tools/telemetry_report.py /tmp/run.jsonl.summary.json
 
 Reads trace files written via ``LGBM_TPU_TRACE=<path>`` or the
 ``telemetry_output`` config parameter (multi-host runs write one
@@ -18,6 +19,12 @@ The share column uses DEPTH-0 spans as the denominator: nested spans
 (e.g. ``gbdt.block`` inside ``gbdt.train`` inside ``engine.train``)
 would otherwise double-count wall-clock.  See README "Observability"
 for the event schema.
+
+A ``*.summary.json`` argument (one JSON object, not JSONL) is
+rendered from the summary side instead — including the
+``device_attribution`` section a ``LGBM_TPU_PROFILE`` run attaches
+(per-span DEVICE time, host gap, roofline columns), via
+``tools/perf_report.py``.
 """
 import json
 import sys
@@ -80,11 +87,51 @@ def report(records, out=sys.stdout):
             print(f"  {name:<40s} {events[name]:>12d}", file=out)
 
 
+def _try_summary(path):
+    """-> a summary dict when ``path`` holds ONE JSON object (the
+    ``.summary.json`` surface), else None (JSONL traces parse line-wise)."""
+    try:
+        with open(path) as f:
+            data = json.load(f)
+        return data if isinstance(data, dict) else None
+    except (OSError, ValueError):
+        return None
+
+
+def report_summary(s, out=sys.stdout):
+    """Host-side span table from a summary dict, then the device-time
+    attribution section when the run was profiled."""
+    spans = s.get("spans", {})
+    total = sum(v.get("total_s", 0.0) for v in spans.values()) or 1.0
+    print(f"summary: rank {s.get('rank', '?')} / "
+          f"{s.get('process_count', '?')} process(es)", file=out)
+    print(f"\n{'span':<28s} {'count':>7s} {'total_s':>10s} {'max_s':>9s}",
+          file=out)
+    print("-" * 58, file=out)
+    for name, v in sorted(spans.items(), key=lambda kv: -kv[1]["total_s"]):
+        print(f"{name:<28s} {v['count']:>7d} {v['total_s']:>10.3f} "
+              f"{v['max_s']:>9.3f}", file=out)
+    da = s.get("device_attribution")
+    if da:
+        print("\n== device attribution (LGBM_TPU_PROFILE capture) ==",
+              file=out)
+        try:
+            from tools.perf_report import render
+        except ImportError:     # invoked as `python tools/telemetry_report.py`
+            from perf_report import render
+        render(da, out=out)
+
+
 def main(argv):
     if not argv:
         print(__doc__)
         return 1
-    report(load_records(argv))
+    summaries = [p for p in argv if _try_summary(p) is not None]
+    traces = [p for p in argv if p not in summaries]
+    for p in summaries:
+        report_summary(_try_summary(p))
+    if traces:
+        report(load_records(traces))
     return 0
 
 
