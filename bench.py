@@ -16,14 +16,17 @@ running, so the final line always lands inside the driver budget.
 Aux legs run in never-captured-first order: multichip (device-count
 guarded, see below), bin255, rank63, serve, rank, valid.
 
-Multi-chip (PR 7, ROADMAP item 1): the ``multichip`` leg trains the
-HIGGS-shape legs data-parallel on 2/4/8-chip meshes with the
-overlapped wave reduction on/off (``LGBM_TPU_OVERLAP``), recording
-per-chip scaling efficiency against the 1-chip serial anchor and a
-byte-identity parity gate between the two schedules.  On a 1-chip
-image it records ``"skipped: devices"`` without touching the
-single-chip headline; ``--dryrun`` re-execs it on a 2-device virtual
-CPU pool as the tier-1 mechanics gate.
+Multi-chip (PR 7 + ISSUE 11, ROADMAP items 1/2): the ``multichip``
+leg trains the HIGGS-shape legs data-parallel on 2/4/8-chip meshes on
+the FUSED scan-block path (one dispatch per window) with the
+overlapped wave reduction on/off (``LGBM_TPU_OVERLAP``) plus the
+unfused per-iteration baseline (``LGBM_TPU_MESH_BLOCK=0``), recording
+per-chip scaling efficiency against the 1-chip serial anchor,
+``fused_speedup`` + the measured dispatch gaps on both dispatch
+modes, and a byte-identity parity gate across all three schedules.
+On a 1-chip image it records ``"skipped: devices"`` without touching
+the single-chip headline; ``--dryrun`` re-execs it on a 2-device
+virtual CPU pool as the tier-1 mechanics gate.
 
 Quality gates: the synthetic legs' train AUC must clear ``AUC_GATE``
 (``BENCH_AUC_GATE``, default 0.93 — calibrated from the recorded
@@ -821,15 +824,24 @@ MULTICHIP_SCHEMA_KEYS = (
     "multichip_parity_ok", "multichip_best_vs_baseline")
 
 
-def _mc_train_rate(ds, y, n, iters, leaves, max_bin, ndev, overlap):
+def _mc_train_rate(ds, y, n, iters, leaves, max_bin, ndev, overlap,
+                   fused=True):
     """Train ``iters`` data-parallel iterations on an ``ndev``-device
-    mesh with the overlapped reduction on/off; -> (row_iters/s, auc,
-    phases, model_text).  The model text backs the bit-parity gate:
-    overlap on/off must produce byte-identical models (the
-    serial-psum-schedule equivalence the overlap lowering guarantees)."""
+    mesh; -> (row_iters/s, auc, phases, model_text).  ``overlap``
+    toggles the chunked double-buffered reduction, ``fused`` the
+    scan-block program (``LGBM_TPU_MESH_BLOCK``): fused runs one
+    dispatch per window, unfused one length-1 block per iteration —
+    byte-identical models either way, so both axes feed the bit-parity
+    gate.  ``phases`` additionally carries ``dispatch_gap_mean_s``
+    (host gap between training dispatches, from the live telemetry
+    counters) — the `gbdt.dispatch_gap_s` regime the fused path
+    exists to kill."""
+    from lightgbm_tpu import obs
     from lightgbm_tpu.basic import Booster
     prev = os.environ.get("LGBM_TPU_OVERLAP")
+    prev_mb = os.environ.get("LGBM_TPU_MESH_BLOCK")
     os.environ["LGBM_TPU_OVERLAP"] = "1" if overlap else "0"
+    os.environ["LGBM_TPU_MESH_BLOCK"] = "1" if fused else "0"
     try:
         params = {"objective": "binary", "num_leaves": leaves,
                   "max_bin": max_bin, "learning_rate": 0.1,
@@ -837,32 +849,43 @@ def _mc_train_rate(ds, y, n, iters, leaves, max_bin, ndev, overlap):
                   "tree_learner": "data", "mesh_shape": [ndev]}
         bst = Booster(params=params, train_set=ds)
         g = bst._gbdt
-        # the mesh path dispatches per iteration (no fused block), so
-        # the compile split is the warm phase's wall clock, not the
-        # gbdt.block_compile span
-        warm = min(iters, 4)
+        # warm with the block length the steady phase will dispatch
+        # (fused: one full-cap window so the scan program compiles
+        # here, not inside the timed phase; residue lengths borrow it)
+        warm = min(iters, g._block_cap if fused else 3)
         t0 = time.time()
         bst.update()
-        g.train_block(warm - 1)
+        g.train_block(warm)
         _sync(g.scores)
         warm_s = time.time() - t0
+        obs.enable()                 # dispatch-gap counters
+        c0 = dict(obs.summary()["counters"])
         t0 = time.time()
         g.train_block(iters)
         _sync(g.scores)
         wall = time.time() - t0
+        c1 = obs.summary()["counters"]
+        gaps = c1.get("gbdt.dispatch_gaps", 0) - c0.get(
+            "gbdt.dispatch_gaps", 0)
+        gap_s = c1.get("gbdt.dispatch_gap_s", 0.0) - c0.get(
+            "gbdt.dispatch_gap_s", 0.0)
         auc = float(_auc(y, np.asarray(g.scores[:, 0])))
         model = g.save_model_to_string()
         phases = {"warm_s": round(warm_s, 3),
-                  "steady_s": round(wall, 3)}
+                  "steady_s": round(wall, 3),
+                  "dispatch_gap_mean_s": (round(gap_s / gaps, 6)
+                                          if gaps else None)}
         del bst, g
         import gc
         gc.collect()
         return n * iters / wall, auc, phases, model
     finally:
-        if prev is None:
-            os.environ.pop("LGBM_TPU_OVERLAP", None)
-        else:
-            os.environ["LGBM_TPU_OVERLAP"] = prev
+        for key, val in (("LGBM_TPU_OVERLAP", prev),
+                         ("LGBM_TPU_MESH_BLOCK", prev_mb)):
+            if val is None:
+                os.environ.pop(key, None)
+            else:
+                os.environ[key] = val
 
 
 def multichip_leg(line=None, dryrun: bool = False):
@@ -878,11 +901,16 @@ def multichip_leg(line=None, dryrun: bool = False):
     mechanics, schema, and the overlap bit-parity gate run as a tier-1
     gate without TPU hardware.
 
-    Per mesh size d: row_iters/s with the double-buffered chunked
-    reduction ON (the production schedule) and OFF (the serial-psum
-    A/B), ``scaling_efficiency`` = rate_on / (d x serial_rate) against
-    the 1-chip serial path (the production single-chip anchor, fused
-    blocks), and the overlap on/off models compared byte-for-byte
+    Per mesh size d (ISSUE 11): row_iters/s on the FUSED scan-block
+    path (the production schedule since the partition-rule refactor:
+    one dispatch per window) with the double-buffered chunked
+    reduction ON and OFF, plus the unfused per-iteration baseline
+    (``LGBM_TPU_MESH_BLOCK=0`` — one dispatch per iteration, the
+    ``gbdt.dispatch_gap_s`` regime) with ``fused_speedup`` and the
+    measured ``dispatch_gap_mean_s`` on both dispatch modes;
+    ``scaling_efficiency`` = rate / (d x serial_rate) against the
+    1-chip serial path (the production single-chip anchor, fused
+    blocks), and all three models compared byte-for-byte
     (``multichip_parity_ok`` — a parity break zeroes the headline:
     a wrong-answer speedup must not score).  Results are emitted
     incrementally per mesh size when ``line`` is given."""
@@ -967,11 +995,20 @@ def multichip_leg(line=None, dryrun: bool = False):
         if _budget_exceeded():
             out.setdefault("multichip_skipped_counts", []).append(d)
             continue
+        # three runs per mesh size: fused+overlap (the production
+        # path: one dispatch per window), fused without the overlapped
+        # reduction (overlap A/B), and the unfused per-iteration
+        # baseline (LGBM_TPU_MESH_BLOCK=0: one length-1 block per
+        # iteration — the dispatch-tunnel regime the fused path
+        # kills).  All three models must be byte-identical.
         r_on, auc_on, ph_on, m_on = _mc_train_rate(
             ds, y, n, iters, leaves, max_bin, d, overlap=True)
         r_off, _, ph_off, m_off = _mc_train_rate(
             ds, y, n, iters, leaves, max_bin, d, overlap=False)
-        parity_ok = parity_ok and (m_on == m_off)
+        r_uf, _, ph_uf, m_uf = _mc_train_rate(
+            ds, y, n, iters, leaves, max_bin, d, overlap=True,
+            fused=False)
+        parity_ok = parity_ok and (m_on == m_off) and (m_on == m_uf)
         vs = r_on / REFERENCE_ROW_ITERS_PER_SEC
         best_vs = max(best_vs, vs)
         table.append({
@@ -979,6 +1016,10 @@ def multichip_leg(line=None, dryrun: bool = False):
             "row_iters_per_sec": round(r_on, 1),
             "no_overlap_row_iters_per_sec": round(r_off, 1),
             "overlap_speedup": round(r_on / max(r_off, 1e-9), 4),
+            "unfused_row_iters_per_sec": round(r_uf, 1),
+            "fused_speedup": round(r_on / max(r_uf, 1e-9), 4),
+            "dispatch_gap_mean_s": ph_on["dispatch_gap_mean_s"],
+            "unfused_dispatch_gap_mean_s": ph_uf["dispatch_gap_mean_s"],
             "scaling_efficiency": round(
                 r_on / max(d * serial_rate, 1e-9), 4),
             "vs_baseline": round(vs, 4),

@@ -161,6 +161,55 @@ def _shared_serial_build(dd, grad, hess, bag, fmask, bins_t, split,
                       hist_mode=hist_mode)
 
 
+def _mesh_score_update_impl(scores, lv, row_leaf, lr, *, k):
+    """Per-iteration mesh score update as ONE jitted program (one
+    dispatch instead of three): gather the shrunk leaf values and add.
+    The arithmetic region compiles exactly like the fused mesh block's
+    update region, which is what keeps the ``LGBM_TPU_MESH_BLOCK=0``
+    escape hatch byte-identical (tests/test_mesh_block.py pins it)."""
+    return scores.at[:, k].add((lr * lv)[row_leaf[:scores.shape[0]]])
+
+
+def _mesh_valid_update_impl(vscore, bt, vd, lr, *, k, matmul):
+    """Per-iteration mesh valid-score update, one program — the same
+    predictor selection and scale-then-predict arithmetic as the fused
+    block (the predictors only gather/select leaf values)."""
+    from ..learner.serial import predict_built_tree_matmul
+    bts = bt._replace(leaf_value=lr * bt.leaf_value)
+    pred = (predict_built_tree_matmul(bts, vd, vd.bins) if matmul
+            else predict_built_tree(bts, vd, vd.bins))
+    return vscore.at[:, k].add(pred)
+
+
+# donated + plain lowerings of the mesh update programs: the gated
+# dispatchers below pick per call (the gbdt block-fn idiom) — on
+# TPU/GPU the running state updates in place, on CPU the zero-copy
+# host-read hazard keeps donation off (see _donation_enabled)
+_mesh_score_update_donated = functools.partial(
+    jax.jit, static_argnames=("k",), donate_argnums=(0,))(
+        _mesh_score_update_impl)
+_mesh_score_update_plain = functools.partial(
+    jax.jit, static_argnames=("k",))(_mesh_score_update_impl)
+_mesh_valid_update_donated = functools.partial(
+    jax.jit, static_argnames=("k", "matmul"), donate_argnums=(0,))(
+        _mesh_valid_update_impl)
+_mesh_valid_update_plain = functools.partial(
+    jax.jit, static_argnames=("k", "matmul"))(_mesh_valid_update_impl)
+
+
+def _mesh_score_update(scores, lv, row_leaf, lr, *, k):
+    if _donation_enabled():
+        return _mesh_score_update_donated(scores, lv, row_leaf, lr, k=k)
+    return _mesh_score_update_plain(scores, lv, row_leaf, lr, k=k)
+
+
+def _mesh_valid_update(vscore, bt, vd, lr, *, k, matmul):
+    if _donation_enabled():
+        return _mesh_valid_update_donated(vscore, bt, vd, lr, k=k,
+                                          matmul=matmul)
+    return _mesh_valid_update_plain(vscore, bt, vd, lr, k=k, matmul=matmul)
+
+
 def growth_params_from_config(c: Config) -> GrowthParams:
     return GrowthParams(
         num_leaves=c.num_leaves, max_depth=c.max_depth,
@@ -323,6 +372,12 @@ class GBDT:
                 log_info(f"boost from average: init score = {v:.6f}")
         if self._pr is not None:
             self.scores = self._pr.globalize(scores_np)
+        elif self.mesh_ctx is not None:
+            # partition-rule placement (parallel/partition.py): the
+            # running scores live under the registry's `scores` rule so
+            # the fused mesh block consumes them in place — an
+            # unregistered name would raise here, not silently default
+            self.scores = self.mesh_ctx.place_scores(scores_np)
         else:
             self.scores = jax.device_put(scores_np)
 
@@ -424,7 +479,6 @@ class GBDT:
         else:
             from ..ops.overlap import overlap_enabled
             from ..parallel.learners import build_tree_distributed
-            from jax.sharding import NamedSharding, PartitionSpec as P
             mesh = self.mesh_ctx.mesh
             axis = self.mesh_ctx.data_axis
             lt, tk = c.tree_learner, c.top_k
@@ -434,18 +488,23 @@ class GBDT:
             # time): an env flip mid-run must not serve a stale trace
             # from the per-instance jit cache
             overlap = overlap_enabled()
-            row_sharded = lt in ("data", "voting")
             if self._pr is None:
-                # place the dataset ONCE under explicit sharding rules
-                # (bins row-sharded / replicated per learner type,
-                # metadata replicated): every per-iteration dispatch
-                # then consumes it in place instead of re-laying-out
-                # the store to the mesh (the multi-process path is
-                # already placed via make_array_from_process_local_data)
+                # place the dataset ONCE under the partition-rule
+                # registry (bins row-sharded / replicated per learner
+                # type, metadata replicated): every dispatch then
+                # consumes it in place instead of re-laying-out the
+                # store to the mesh (the multi-process path is already
+                # placed via make_array_from_process_local_data)
                 self.device_data = self.mesh_ctx.place_data(
-                    self.device_data, row_sharded=row_sharded)
+                    self.device_data)
             pad = self._row_pad
-            row_ns = NamedSharding(mesh, P(axis) if row_sharded else P())
+            # in-program placement constraints come from the SAME
+            # registry rules (grad/hess/bag row-sharded for data/
+            # voting, replicated for feature) — the registry is the
+            # only placement mechanism, eager and traced alike
+            grad_ns = self.mesh_ctx.sharding_for("grad")
+            hess_ns = self.mesh_ctx.sharding_for("hess")
+            bag_ns = self.mesh_ctx.sharding_for("bag_mask")
 
             def _raw_build(dd, grad, hess, bag, fmask, bins_t=None):
                 # row padding + placement INSIDE the jitted program:
@@ -460,13 +519,27 @@ class GBDT:
                     hess = jnp.concatenate(
                         [hess, jnp.zeros(pad, hess.dtype)])
                     bag = jnp.concatenate([bag, jnp.zeros(pad, bool)])
-                grad = jax.lax.with_sharding_constraint(grad, row_ns)
-                hess = jax.lax.with_sharding_constraint(hess, row_ns)
-                bag = jax.lax.with_sharding_constraint(bag, row_ns)
+                grad = jax.lax.with_sharding_constraint(grad, grad_ns)
+                hess = jax.lax.with_sharding_constraint(hess, hess_ns)
+                bag = jax.lax.with_sharding_constraint(bag, bag_ns)
                 return build_tree_distributed(
                     mesh, axis, lt, dd, grad, hess, growth,
                     bag_mask=bag, feature_mask=fmask, top_k=tk,
                     hist_mode=dist_hist_mode, overlap=overlap)
+
+            # the fused mesh scan block (see _make_block_fn) runs this
+            # same build per scan-body iteration; watchdog-wise the
+            # mesh follows the serial rule — long chained-scatter
+            # blocks only on Pallas-capable configs
+            from ..learner.serial import (default_hist_mode,
+                                          effective_hist_mode,
+                                          resolve_backend, uses_pallas)
+            mesh_hist_mode = effective_hist_mode(
+                dist_hist_mode or default_hist_mode(), self.num_data)
+            mesh_backend = resolve_backend(
+                self.device_data, growth.num_leaves, hist_mode=mesh_hist_mode)
+            self._block_backend_ok = (jax.default_backend() != "tpu"
+                                      or uses_pallas(mesh_backend))
         # serial path: already jitted at module level (shared cache);
         # mesh path: per-instance jit (mesh/axis closed over), with
         # grad/hess donated — they die with the build (every caller
@@ -476,6 +549,11 @@ class GBDT:
         # compile/enqueue time, before execution consumes the buffers
         # (LGBM_TPU_DONATE=0 restores undonated dispatches for A/B;
         # CPU never donates — see _donation_enabled).
+        # the un-jitted build closure: the fused scan block's body
+        # traces it inline (one dispatch per block instead of per
+        # iteration — the mesh path included since the partition-rule
+        # refactor)
+        self._raw_build = _raw_build
         if self.mesh_ctx is None:
             self._jit_build = _raw_build
         elif _donation_enabled():
@@ -538,6 +616,13 @@ class GBDT:
         if ms is not None:
             score = jnp.asarray(
                 np.asarray(ms, np.float64).reshape(-1, K, order="F"), jnp.float32)
+        if self.mesh_ctx is not None and self._pr is None:
+            # valid state rides the fused mesh block as scan carries:
+            # place the valid store + running scores ONCE under their
+            # `valid/<i>/...` partition rules (replicated)
+            vd, score = self.mesh_ctx.place_valid(
+                len(self._valid_device) - 1, self._valid_device[-1], score)
+            self._valid_device[-1] = vd
         # replay existing trees (continued training)
         if self.models:
             for it in range(len(self.models) // K):
@@ -788,6 +873,20 @@ class GBDT:
 
     def _update_scores(self, bt: BuiltTree, k: int) -> None:
         lr = self.shrinkage_rate
+        if self.mesh_ctx is not None and self._pr is None:
+            # one jitted program per update (see _mesh_score_update):
+            # byte-identical arithmetic to the fused mesh block AND
+            # fewer per-iteration dispatches on the escape-hatch path
+            # (multi-process keeps the eager update: its valid stores
+            # are process-local while bt/scores span the global mesh)
+            self.scores = _mesh_score_update(
+                self.scores, bt.leaf_value, bt.row_leaf,
+                jnp.float32(lr), k=k)
+            for i, vd in enumerate(self._valid_device):
+                self._valid_scores[i] = _mesh_valid_update(
+                    self._valid_scores[i], bt, vd, jnp.float32(lr), k=k,
+                    matmul=not vd.has_categorical)
+            return
         if bt.row_value.shape[0] and not (
                 self.objective is not None
                 and self.objective.need_renew_tree_output):
@@ -1008,15 +1107,23 @@ class GBDT:
         The remote-device tunnel charges ~ms per enqueued op; a block
         collapses a whole window of iterations into a single dispatch
         (gradients → tree build → score update chained on device).
-        Excluded: distributed meshes (own path), custom fobj (host
-        callback), leaf renewal (quantile-style refit), non-plain
-        boosters (DART/RF override the iteration), and the per-phase
-        timetag debug mode (host-driven waves).  Valid sets stay IN the
-        block since r5: their per-tree scoring runs on device inside
-        the scan (path-agreement matmul / node walk).  Bagging and feature_fraction stay IN the
-        block: their masks are pure functions of (seed, iteration) /
-        (seed, tree index), derived on device inside the scan body —
-        identical to the per-iteration path's masks."""
+        Single-process device MESHES ride the same fused block since
+        the partition-rule refactor: the scan body traces the
+        distributed build (shard_map + overlapped psum wave) in place
+        of the serial one, so a d-chip mesh pays one dispatch per
+        window instead of one per iteration (``LGBM_TPU_MESH_BLOCK=0``
+        is the per-iteration escape hatch / A-B baseline).  Excluded:
+        multi-process training (per-iteration host-side mask
+        globalization), custom fobj (host callback), leaf renewal
+        (quantile-style refit), non-plain boosters (DART/RF override
+        the iteration), and the per-phase timetag debug mode
+        (host-driven waves).  Valid sets stay IN the block since r5:
+        their per-tree scoring runs on device inside the scan
+        (path-agreement matmul / node walk).  Bagging and
+        feature_fraction stay IN the block: their masks are pure
+        functions of (seed, iteration) / (seed, tree index), derived on
+        device inside the scan body — identical to the per-iteration
+        path's masks."""
         from ..utils.timetag import phases_enabled
         if phases_enabled():
             return False
@@ -1025,8 +1132,9 @@ class GBDT:
             # large n) can push a 32-iteration block past the device's
             # dispatch watchdog; per-iteration dispatches stay short
             return False
+        if self.mesh_ctx is not None and self._pr is not None:
+            return False
         return (self.boosting_name in ("gbdt", "goss")
-                and self.mesh_ctx is None
                 and self.fobj is None
                 and self.objective is not None
                 and not self.objective.need_renew_tree_output
@@ -1070,6 +1178,13 @@ class GBDT:
         # decelerating training, gbdt.cpp:492+, score_updater.hpp:54-100)
         from ..learner.serial import (predict_built_tree,
                                       predict_built_tree_matmul)
+        # the mesh path's scan body traces the SAME distributed build
+        # closure the per-iteration path jits (_raw_build: in-program
+        # row padding + registry sharding constraints + shard_map wave
+        # loop), so the flight-recorder collective schedule per trace —
+        # one hist_psum fingerprint per wave — is identical on both
+        # paths; only the dispatch count changes (one per window)
+        mesh_build = self._raw_build if self.mesh_ctx is not None else None
 
         def block(dd, bins_t, vds, scores, vscores, lr, it0, n_active):
             def body(carry, it):
@@ -1086,34 +1201,73 @@ class GBDT:
                 # (and GOSS: _block_sample override) configs stay on
                 # the fused fast path
                 G, H, bag = self._block_sample(G, H, it)
+                if mesh_build is not None:
+                    # BYTE-identity fence vs the per-iteration mesh
+                    # path: eagerly, gradients materialize as f32
+                    # program outputs before the build consumes them;
+                    # fused, XLA would contract producer/consumer
+                    # mul+add chains into FMAs with different last-ulp
+                    # rounding.  The barrier reproduces the eager
+                    # program boundary at zero runtime cost.
+                    G, H = jax.lax.optimization_barrier((G, H))
+                    if bag is not None:
+                        bag = jax.lax.optimization_barrier(bag)
                 outs = []
                 for k in range(K):
                     fmask = (_device_feature_mask(c.feature_fraction_seed,
                                                   it * K + k, F, kf)
                              if ff_on else None)
-                    bt = build_tree(dd, G[:, k], H[:, k], growth,
-                                    bag_mask=bag, feature_mask=fmask,
-                                    bins_t=bins_t,
-                                    hist_mode=c.hist_mode or None)
+                    if mesh_build is not None:
+                        bt = mesh_build(dd, G[:, k], H[:, k], bag, fmask)
+                    else:
+                        bt = build_tree(dd, G[:, k], H[:, k], growth,
+                                        bag_mask=bag, feature_mask=fmask,
+                                        bins_t=bins_t,
+                                        hist_mode=c.hist_mode or None)
                     lv = jnp.where(bt.num_leaves > 1, bt.leaf_value,
                                    jnp.zeros_like(bt.leaf_value))
                     bt = bt._replace(leaf_value=lv)
-                    if bt.row_value.shape[0]:
-                        # emitted by the final route kernel (already
-                        # stump-masked); avoids the 1M-row gather
-                        scores = scores.at[:, k].add(lr * bt.row_value)
+                    if mesh_build is not None:
+                        # byte-identity vs the per-iteration mesh path
+                        # (LGBM_TPU_MESH_BLOCK=0): the fence keeps the
+                        # build subgraph's internal fusion identical to
+                        # its standalone jit, and the update mirrors
+                        # _mesh_score_update / _mesh_valid_update's
+                        # contraction-proof scale-then-gather shape —
+                        # identical last-ulp rounding in any fusion
+                        # context
+                        bt = jax.lax.optimization_barrier(bt)
+                        lv_s = lr * bt.leaf_value            # [L]
+                        scores = scores.at[:, k].add(
+                            lv_s[bt.row_leaf[:scores.shape[0]]])
+                        bts = bt._replace(leaf_value=lv_s)
+                        vscores = tuple(
+                            vs.at[:, k].add(
+                                predict_built_tree(bts, vd, vd.bins)
+                                if vd.has_categorical else
+                                predict_built_tree_matmul(bts, vd,
+                                                          vd.bins))
+                            for vs, vd in zip(vscores, vds))
                     else:
-                        scores = scores.at[:, k].add(lr * lv[bt.row_leaf])
-                    # valid-set scoring per tree, on device: the
-                    # path-agreement matmul (MXU) for numerical valid
-                    # sets, the node walk where categorical splits
-                    # need the bitset decision
-                    vscores = tuple(
-                        vs.at[:, k].add(lr * (
-                            predict_built_tree(bt, vd, vd.bins)
-                            if vd.has_categorical else
-                            predict_built_tree_matmul(bt, vd, vd.bins)))
-                        for vs, vd in zip(vscores, vds))
+                        if bt.row_value.shape[0]:
+                            # emitted by the final route kernel (already
+                            # stump-masked); avoids the 1M-row gather
+                            scores = scores.at[:, k].add(
+                                lr * bt.row_value)
+                        else:
+                            scores = scores.at[:, k].add(
+                                lr * lv[bt.row_leaf])
+                        # valid-set scoring per tree, on device: the
+                        # path-agreement matmul (MXU) for numerical
+                        # valid sets, the node walk where categorical
+                        # splits need the bitset decision
+                        vscores = tuple(
+                            vs.at[:, k].add(lr * (
+                                predict_built_tree(bt, vd, vd.bins)
+                                if vd.has_categorical else
+                                predict_built_tree_matmul(bt, vd,
+                                                          vd.bins)))
+                            for vs, vd in zip(vscores, vds))
                     outs.append(bt._replace(row_leaf=bt.row_leaf[:0],
                                             row_value=bt.row_value[:0]))
                 stacked = (outs[0] if K == 1 else
@@ -1282,6 +1436,16 @@ class GBDT:
                      and self.boosting_name == "gbdt")  # GOSS resamples
         prev_check = None                  # pending num_leaves slice
         stopped = False
+        # LGBM_TPU_MESH_BLOCK=0: the fused-mesh A/B escape hatch —
+        # per-ITERATION dispatch granularity (length-1 blocks of the
+        # SAME compiled scan body), so the unfused baseline is
+        # byte-identical by construction and the only variable is the
+        # dispatch count.  Resolved per call: an env flip mid-run just
+        # switches the next window's block length.
+        cap = self._block_cap
+        if (self.mesh_ctx is not None
+                and _os.environ.get("LGBM_TPU_MESH_BLOCK", "1") == "0"):
+            cap = 1
         while done < num_iters and not stopped:
             if not self._can_block():
                 # unsupported config: per-iteration path
@@ -1289,7 +1453,7 @@ class GBDT:
                     return True
                 done += 1
                 continue
-            nb = min(num_iters - done, self._block_cap)
+            nb = min(num_iters - done, cap)
             L = self._pick_block_len(nb)
             # a length whose program is not cached yet pays trace +
             # XLA compile inside this dispatch: billed to the
@@ -1726,8 +1890,12 @@ class GBDT:
                             f"needs {want}; replaying trees instead")
                 state = None
         if state is not None:
-            self.scores = jax.device_put(
-                np.asarray(state["scores"], np.float32))
+            restored = np.asarray(state["scores"], np.float32)
+            if self.mesh_ctx is not None:
+                # registry placement (scores rule), like _init_train
+                self.scores = self.mesh_ctx.place_scores(restored)
+            else:
+                self.scores = jax.device_put(restored)
             for i in range(len(self._valid_scores)):
                 vs = state.get(f"valid_scores_{i}")
                 if vs is not None and vs.shape == tuple(

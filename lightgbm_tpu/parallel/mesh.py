@@ -190,7 +190,14 @@ class ProcessRows:
 
 
 class MeshContext:
-    """A 1-D (data) or 2-D (data × feature) device mesh + shard helpers."""
+    """A 1-D (data) or 2-D (data × feature) device mesh + shard helpers.
+
+    All placement decisions flow through the partition-rule registry
+    (``parallel/partition.py``): ``partition_rules()`` is the rule
+    table for this mesh's learner type, ``sharding_for(name)`` resolves
+    one persistent name, and ``place_data``/``place_scores``/
+    ``place_valid`` place whole state groups — an array name without a
+    rule raises instead of inheriting a default layout."""
 
     def __init__(self, config: Config, devices: Optional[Sequence] = None):
         self.config = config
@@ -224,6 +231,25 @@ class MeshContext:
         return (self.mesh.shape[self.feature_axis]
                 if self.feature_axis in self.mesh.shape else 1)
 
+    @property
+    def row_sharded(self) -> bool:
+        """Whether this mesh's learner type shards the row axis
+        (data/voting) or replicates rows (feature-parallel)."""
+        return self.config.tree_learner in ("data", "voting")
+
+    def partition_rules(self):
+        """The partition-rule table governing every persistent array
+        placed on THIS mesh (see ``parallel/partition.py``)."""
+        from .partition import train_rules
+        return train_rules(self.data_axis, self.row_sharded)
+
+    def sharding_for(self, name: str) -> NamedSharding:
+        """Resolve one persistent array name through the registry —
+        an unmatched name raises ``PartitionRuleError``."""
+        from .partition import match_name
+        return NamedSharding(self.mesh,
+                             match_name(self.partition_rules(), name))
+
     def row_sharding(self) -> NamedSharding:
         """[n, ...] arrays sharded over rows."""
         return NamedSharding(self.mesh, P(self.data_axis))
@@ -231,24 +257,47 @@ class MeshContext:
     def replicated(self) -> NamedSharding:
         return NamedSharding(self.mesh, P())
 
-    def place_data(self, dd, row_sharded: bool = True):
-        """Place a DeviceData ONCE under explicit sharding rules: the
-        bins store sharded over the data axis rows (replicated for
-        feature-parallel, which replicates rows), every per-feature
-        metadata array replicated.  Without this, each jitted
-        distributed build re-lays-out the single-device store to the
-        mesh per dispatch — at the 10.5M-row HIGGS shape that is a
-        ~294 MB reshard of the biggest buffer EVERY iteration.  The
-        pjit shard-rule pattern of SNIPPETS.md [1]/[2] (fmengine /
-        EasyDeL trainers place params once, then every step consumes
-        them in place) applied to the GBDT training store."""
+    def place_data(self, dd, row_sharded: Optional[bool] = None):
+        """Place a DeviceData ONCE under the partition-rule registry:
+        ``data/bins`` sharded over the data axis rows (replicated for
+        feature-parallel, which replicates rows), every ``data/<meta>``
+        array replicated.  Without this, each jitted distributed build
+        re-lays-out the single-device store to the mesh per dispatch —
+        at the 10.5M-row HIGGS shape that is a ~294 MB reshard of the
+        biggest buffer EVERY iteration.  The pjit shard-rule pattern of
+        SNIPPETS.md [1]/[2] (fmengine / EasyDeL trainers place params
+        once, then every step consumes them in place) applied to the
+        GBDT training store."""
         from ..io.device import DeviceData
+        from .partition import device_data_names, place_tree, train_rules
         children, aux = dd.tree_flatten()
-        row = self.row_sharding() if row_sharded else self.replicated()
-        rep = self.replicated()
-        bins = jax.device_put(children[0], row)
-        meta = [jax.device_put(c, rep) for c in children[1:]]
-        return DeviceData(bins, *meta, *aux)
+        rules = (self.partition_rules() if row_sharded is None
+                 else train_rules(self.data_axis, row_sharded))
+        placed = place_tree(rules, self.mesh,
+                            {"data": device_data_names(dd)})["data"]
+        fields = type(dd)._fields
+        return DeviceData(*(placed[f] for f in fields[:len(children)]), *aux)
+
+    def place_scores(self, scores) -> jax.Array:
+        """Place a running score state (``scores`` / ``valid/i/scores``)
+        under its registry rule (replicated: host eval reads it per
+        window, and the row count is the unpadded n)."""
+        return jax.device_put(scores, self.sharding_for("scores"))
+
+    def place_valid(self, i: int, dd, scores):
+        """Place valid set ``i``'s DeviceData + running scores under
+        the ``valid/<i>/...`` rules (all replicated)."""
+        from ..io.device import DeviceData
+        from .partition import device_data_names, place_tree
+        tree = {"valid": {str(i): {"data": device_data_names(dd),
+                                   "scores": scores}}}
+        placed = place_tree(self.partition_rules(), self.mesh,
+                            tree)["valid"][str(i)]
+        children, aux = dd.tree_flatten()
+        fields = type(dd)._fields
+        dd_placed = DeviceData(
+            *(placed["data"][f] for f in fields[:len(children)]), *aux)
+        return dd_placed, placed["scores"]
 
     def pad_rows(self, n: int) -> int:
         """Rows padded to a multiple of the data-shard count."""

@@ -209,7 +209,7 @@ def build_pack(trees: Sequence[Tree], mappers=None,
     # power-of-two walk bound: the loop length is a static program
     # parameter, so raw depths would recompile per forest shape
     depth = 1 << (depth - 1).bit_length()
-    return ServePack(
+    return place_pack(ServePack(
         jnp.asarray(sf), jnp.asarray(thr), jnp.asarray(dl), jnp.asarray(ic),
         jnp.asarray(mz), jnp.asarray(mn), jnp.asarray(lc), jnp.asarray(rc),
         jnp.asarray(hi), jnp.asarray(lo), jnp.asarray(co), jnp.asarray(cn),
@@ -217,7 +217,29 @@ def build_pack(trees: Sequence[Tree], mappers=None,
         jnp.asarray(sfi), jnp.asarray(tb), jnp.asarray(bo), jnp.asarray(bn),
         jnp.asarray(np.asarray(catbin_words or [0], np.uint32)),
         jnp.asarray(fi_mt), jnp.asarray(fi_nan), jnp.asarray(fi_zero),
-        depth)
+        depth))
+
+
+def place_pack(pack: ServePack, mesh=None) -> ServePack:
+    """Route the compiled forest through the partition-rule registry
+    (``parallel/partition.py``): every ``serve/pack/<field>`` array
+    must match a rule — an unregistered field is a hard error at
+    compile time, exactly like a training array without a placement
+    rule.  The serve rules are all REPLICATED for now, so without a
+    ``mesh`` this is resolution-only (no behavior change: the
+    single-chip server keeps its default placement byte-for-byte);
+    with a mesh the pack is device_put replicated across it — the seam
+    the trees-axis sharding of ROADMAP item 3a will refine."""
+    from ..parallel.partition import (match_partition_rules, place_tree,
+                                      serve_pack_names, serve_rules)
+    names = serve_pack_names(pack)
+    match_partition_rules(serve_rules(), names)    # completeness: raises
+    if mesh is None:
+        return pack
+    placed = place_tree(serve_rules(), mesh, names)["serve"]["pack"]
+    children, aux = pack.tree_flatten()
+    fields = ServePack._fields
+    return ServePack(*(placed[f] for f in fields[:len(children)]), aux)
 
 
 # ---------------------------------------------------------------------------

@@ -145,10 +145,11 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     assert out["serve_requests"] > 0
     for rec in out["serve_latency_ms"].values():
         assert rec["count"] > 0 and rec["p99"] >= rec["p50"] >= 0.0
-    # multichip mechanics gate (PR 7): the REAL leg ran on a 2-device
-    # virtual CPU pool (re-exec'd child) — schema complete, both
-    # overlap modes measured, and the overlap on/off models
-    # byte-identical (the serial-psum-schedule bit-parity contract)
+    # multichip mechanics gate (PR 7 + ISSUE 11): the REAL leg ran on
+    # a 2-device virtual CPU pool (re-exec'd child) — schema complete,
+    # overlap on/off AND fused/unfused (LGBM_TPU_MESH_BLOCK) measured,
+    # all three models byte-identical (the bit-parity contract), and
+    # the dispatch-gap columns populated on both dispatch modes
     from bench import MULTICHIP_SCHEMA_KEYS
     assert out["multichip_schema_ok"] is True, out.get(
         "multichip_leg", out.get("multichip_schema_missing"))
@@ -161,8 +162,11 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
         assert row["devices"] >= 2
         assert row["row_iters_per_sec"] > 0
         assert row["no_overlap_row_iters_per_sec"] > 0
+        assert row["unfused_row_iters_per_sec"] > 0
         assert row["scaling_efficiency"] > 0
         assert row["overlap_speedup"] > 0
+        assert row["fused_speedup"] > 0
+        assert row["unfused_dispatch_gap_mean_s"] is not None
     # extended north_star tables (255-bin / MSLR / multichip): either
     # measured rows or an explicit pending-capture spec — and the toy
     # aux wave tables actually ran

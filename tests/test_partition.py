@@ -1,0 +1,188 @@
+"""Partition-rule sharding registry (ISSUE 11 tentpole).
+
+The registry (`lightgbm_tpu/parallel/partition.py`) is the ONLY
+placement mechanism: every persistent array name must match exactly one
+``(name, regex, PartitionSpec)`` rule, an unmatched name is a hard
+error (never a silent default layout), and the same table drives
+``MeshContext.place_data`` / ``place_scores`` / ``place_valid`` on the
+training side and ``serve.compiler.place_pack`` on the serving side.
+``tools/partition_audit.py`` is the memcheck-style completeness gate.
+"""
+import numpy as np
+import jax
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset
+from lightgbm_tpu.io.device import DeviceData, to_device
+from lightgbm_tpu.parallel.partition import (PartitionRuleError, audit_rules,
+                                             device_data_names,
+                                             flatten_names, match_name,
+                                             match_partition_rules,
+                                             persistent_names,
+                                             serve_pack_names, serve_rules,
+                                             train_rules)
+
+
+@pytest.fixture(scope="module")
+def dd():
+    rng = np.random.RandomState(0)
+    X = rng.normal(size=(512, 5)).astype(np.float32)
+    return to_device(BinnedDataset.from_raw(
+        X, Config.from_params({"max_bin": 31})))
+
+
+# ---------------------------------------------------------------------------
+# rule matching
+# ---------------------------------------------------------------------------
+def test_match_name_resolves_core_rules():
+    rules = train_rules("data", True)
+    assert match_name(rules, "data/bins") == P("data")
+    assert match_name(rules, "data/num_bins") == P()
+    assert match_name(rules, "grad") == P("data")
+    assert match_name(rules, "hess") == P("data")
+    assert match_name(rules, "bag_mask") == P("data")
+    assert match_name(rules, "scores") == P()
+    assert match_name(rules, "valid/0/scores") == P()
+    assert match_name(rules, "valid/3/data/bins") == P()
+    assert match_name(rules, "serve/pack/leaf_hi") == P()
+
+
+def test_feature_parallel_rules_replicate_rows():
+    rules = train_rules("data", False)
+    assert match_name(rules, "data/bins") == P()
+    assert match_name(rules, "grad") == P()
+
+
+def test_unmatched_name_is_a_hard_error():
+    rules = train_rules("data", True)
+    with pytest.raises(PartitionRuleError, match="no partition rule"):
+        match_name(rules, "some/new/array")
+    with pytest.raises(PartitionRuleError):
+        match_partition_rules(rules, {"mystery": np.zeros(4)})
+
+
+def test_audit_every_persistent_name_matches_exactly_one_rule():
+    """The completeness contract: the canonical persistent-name set
+    (derived from the REAL DeviceData/ServePack fields) is totally and
+    unambiguously covered — in both learner contexts and for serve."""
+    names = persistent_names(num_valid=2)
+    # the set spans train AND serve
+    assert any(n.startswith("data/") for n in names)
+    assert any(n.startswith("serve/pack/") for n in names)
+    assert "scores" in names and "grad" in names
+    for row_sharded in (True, False):
+        assert audit_rules(train_rules("data", row_sharded), names) == []
+    assert audit_rules(
+        serve_rules(), [n for n in names if n.startswith("serve/")]) == []
+
+
+def test_audit_flags_uncovered_and_ambiguous_names():
+    rules = train_rules("data", True)
+    out = audit_rules(rules, ["data/bins", "rogue_array"])
+    assert len(out) == 1 and "rogue_array" in out[0] and "NO" in out[0]
+    # a deliberately overlapping extra rule -> ambiguity finding
+    overlapping = rules + (("dup_bins", r"^data/bins$", P()),)
+    out = audit_rules(overlapping, ["data/bins"])
+    assert len(out) == 1 and "2 rules" in out[0]
+
+
+def test_partition_audit_tool_is_green():
+    from tools.partition_audit import main, run_audit
+    assert run_audit() == []
+    assert main([]) == 0
+
+
+def test_match_partition_rules_scalars_never_partition(dd):
+    specs = match_partition_rules(train_rules("data", True),
+                                  {"data": device_data_names(dd)})
+    assert specs["data/bins"] == P("data")
+    assert specs["data/feat_group"] == P()
+    # every array child of the REAL DeviceData resolved
+    assert len(specs) == len(DeviceData._fields[:9])
+
+
+def test_flatten_names_joins_nested_dicts_and_lists():
+    tree = {"a": {"b": [np.zeros(2), np.zeros(3)]}, "c": np.zeros(1)}
+    names = dict(flatten_names(tree))
+    assert set(names) == {"a/b/0", "a/b/1", "c"}
+
+
+# ---------------------------------------------------------------------------
+# placement through MeshContext
+# ---------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def two_devices():
+    if len(jax.devices()) < 2:
+        pytest.skip("needs >=2 virtual devices")
+    return jax.devices()[:2]
+
+
+def test_mesh_place_data_follows_registry(dd, two_devices):
+    from lightgbm_tpu.parallel.mesh import MeshContext
+    ctx = MeshContext(Config.from_params(
+        {"tree_learner": "data", "mesh_shape": [2]}))
+    placed = ctx.place_data(dd)
+    assert placed.bins.sharding == ctx.sharding_for("data/bins")
+    assert placed.bins.sharding == ctx.row_sharding()
+    assert placed.num_bins.sharding.is_equivalent_to(
+        ctx.replicated(), placed.num_bins.ndim)
+    np.testing.assert_array_equal(np.asarray(placed.bins),
+                                  np.asarray(dd.bins))
+    assert placed.total_bins == dd.total_bins
+    # feature-parallel context: rows replicate
+    ctx_f = MeshContext(Config.from_params(
+        {"tree_learner": "feature", "mesh_shape": [2]}))
+    placed_f = ctx_f.place_data(dd)
+    assert placed_f.bins.sharding.is_equivalent_to(
+        ctx_f.replicated(), placed_f.bins.ndim)
+
+
+def test_mesh_place_scores_and_valid(dd, two_devices):
+    from lightgbm_tpu.parallel.mesh import MeshContext
+    ctx = MeshContext(Config.from_params(
+        {"tree_learner": "data", "mesh_shape": [2]}))
+    scores = np.random.RandomState(0).normal(
+        size=(512, 1)).astype(np.float32)
+    placed = ctx.place_scores(scores)
+    assert placed.sharding.is_equivalent_to(ctx.replicated(), placed.ndim)
+    np.testing.assert_array_equal(np.asarray(placed), scores)
+    vd, vs = ctx.place_valid(0, dd, placed)
+    assert vd.bins.sharding.is_equivalent_to(ctx.replicated(), vd.bins.ndim)
+    assert vs.sharding.is_equivalent_to(ctx.replicated(), vs.ndim)
+
+
+def test_mesh_sharding_for_unknown_name_raises(two_devices):
+    from lightgbm_tpu.parallel.mesh import MeshContext
+    ctx = MeshContext(Config.from_params(
+        {"tree_learner": "data", "mesh_shape": [2]}))
+    with pytest.raises(PartitionRuleError):
+        ctx.sharding_for("not/a/registered/name")
+
+
+# ---------------------------------------------------------------------------
+# serve pack coverage
+# ---------------------------------------------------------------------------
+def test_serve_pack_registers_through_registry():
+    """Every ServePack array field resolves through the serve rules —
+    the registry spans train AND serve (a new pack field that forgets
+    to register fails compile, not silently defaults)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve.compiler import ServePack, build_pack, place_pack
+    rng = np.random.RandomState(3)
+    X = rng.rand(300, 4).astype(np.float32)
+    y = (X[:, 0] > 0.5).astype(np.float64)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=3,
+                    verbose_eval=False)
+    g = bst._gbdt
+    pack = build_pack(g.models, mappers=g.train_set.mappers,
+                      used_features=g.train_set.used_features)
+    names = dict(flatten_names(serve_pack_names(pack)))
+    assert set(names) == {f"serve/pack/{f}" for f in ServePack._fields[:-1]}
+    specs = match_partition_rules(serve_rules(), serve_pack_names(pack))
+    assert all(s == P() for s in specs.values())
+    # resolution-only without a mesh: the pack is returned as-is
+    assert place_pack(pack) is pack

@@ -110,6 +110,7 @@ class Target:
     classes: int = 1
     valid_rows: int = 0
     block_cap: int = 32
+    devices: int = 1             # data-parallel mesh size (fused block)
     trees: int = 0               # serve
     bucket_rows: int = 0         # serve
     slack: float = 1.25
@@ -146,6 +147,7 @@ def load_targets(path: str) -> Tuple[List[Target], Optional[str]]:
                 classes=int(t.get("classes", 1)),
                 valid_rows=int(t.get("valid_rows", 0)),
                 block_cap=int(t.get("block_cap", 32)),
+                devices=max(1, int(t.get("devices", 1))),
                 trees=int(t.get("trees", 0)),
                 bucket_rows=int(t.get("bucket_rows", 0)),
                 slack=float(t.get("slack", 1.25))))
@@ -155,23 +157,34 @@ def load_targets(path: str) -> Tuple[List[Target], Optional[str]]:
 
 
 def train_footprint(t: Target) -> Footprint:
+    """Per-DEVICE live bytes of one training dispatch.  ``devices > 1``
+    models the fused data-parallel mesh block program under the
+    partition-rule registry (`parallel/partition.py`): row-sharded
+    arrays (``data/bins`` and its transposed kernel copy, grad/hess,
+    bag mask, routed leaves) charge each device 1/d of the row axis,
+    while the registry's REPLICATED arrays (scores, valid state) and
+    the psum'd full-width histogram state stay whole per device."""
     n, F, K = t.rows, t.features, max(1, t.classes)
     B = bin_stride(t.max_bin)
-    n_pad = _round_up(n, 2048)
+    # per-device row shard (rows pad to a device multiple before the
+    # shard, so ceil covers the padded block)
+    n_dev = -(-t.rows // t.devices)
+    n_pad = _round_up(n_dev, 2048)
     F_pad = _round_up(F, 8)
     fp = Footprint()
-    fp.parts["bins"] = n * F                       # [n, F] uint8
-    fp.parts["bins_transposed"] = F_pad * n_pad    # [F_pad, n_pad] uint8
+    fp.parts["bins"] = n_dev * F                   # [n/d, F] uint8 shard
+    fp.parts["bins_transposed"] = F_pad * n_pad    # [F_pad, n_pad/d] uint8
     # one live score generation (donated in-place update) + one
     # dispatch-headroom set for the result materializing before the
-    # donor is released
+    # donor is released.  REPLICATED per the scores partition rule:
+    # host eval reads the full [n, K] on every device
     fp.parts["scores"] = 2 * n * K * 4
     if t.valid_rows:
         fp.parts["valid_scores"] = 2 * t.valid_rows * K * 4
         fp.parts["valid_bins"] = t.valid_rows * F
-    fp.parts["grad_hess"] = 2 * 2 * n * K * 4
-    fp.parts["bag_mask"] = n
-    fp.parts["row_leaf_values"] = n * 4 + n * 4
+    fp.parts["grad_hess"] = 2 * 2 * n_dev * K * 4
+    fp.parts["bag_mask"] = n_dev
+    fp.parts["row_leaf_values"] = n_dev * 4 + n_dev * 4
     # full sibling-subtract histogram state + one in-flight wave block
     fp.parts["hist_state"] = t.leaves * F * B * 3 * 4
     wave_cols = _round_up(5 * 128, LANE)     # C=5 cols x 128-slot cap

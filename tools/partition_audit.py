@@ -1,0 +1,75 @@
+"""Partition-registry completeness gate — the memcheck-style wall for
+device placement (ISSUE 11 satellite).
+
+``tools/memcheck`` proves statically that no dispatch exceeds the HBM
+budget; this gate proves that every PERSISTENT array name the system
+can place on a mesh matches **exactly one** partition rule in
+``lightgbm_tpu/parallel/partition.py`` — an unmatched name is a hard
+error (the runtime ``match_name`` raises the same way), and an
+AMBIGUOUS name (two overlapping rules) fails here before it can make
+two placement sites disagree about a layout.
+
+The audited name set is derived from the REAL ``DeviceData`` and
+``ServePack`` NamedTuple fields plus the booster-level state names
+(``persistent_names``), so a newly added persistent field is audited
+automatically — it either matches a rule or turns this gate red.
+
+Checked contexts: data/voting (row-sharded), feature (replicated
+rows), and the serve rule table on its own.  Exit 1 on any finding;
+``file:rule`` style output mirrors the other analyzers.
+
+Usage::
+
+    python -m tools.partition_audit            # gate (exit 1 on red)
+    python -m tools.partition_audit --table    # print the rule table
+"""
+from __future__ import annotations
+
+import sys
+
+
+def run_audit() -> list:
+    """-> findings (empty == clean).  Imports the live registry so the
+    audit can never drift from the shipped rules."""
+    from lightgbm_tpu.parallel.partition import (audit_rules,
+                                                 persistent_names,
+                                                 serve_rules, train_rules)
+    findings = []
+    names = persistent_names(num_valid=2)
+    for label, rules in (
+            ("train[row-sharded]", train_rules("data", True)),
+            ("train[replicated-rows]", train_rules("data", False))):
+        for f in audit_rules(rules, names):
+            findings.append(f"PARTITION001 {label}: {f}")
+    serve_names = [n for n in names if n.startswith("serve/")]
+    for f in audit_rules(serve_rules(), serve_names):
+        findings.append(f"PARTITION001 serve: {f}")
+    return findings
+
+
+def rule_table() -> str:
+    from lightgbm_tpu.parallel.partition import train_rules
+    lines = ["rule            regex                     spec (data/voting)"]
+    for name, rx, spec in train_rules("data", True):
+        lines.append(f"{name:<15} {rx:<25} {spec}")
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if "--table" in argv:
+        print(rule_table())
+        return 0
+    findings = run_audit()
+    for f in findings:
+        print(f)
+    if findings:
+        print(f"partition_audit: {len(findings)} finding(s)")
+        return 1
+    print("partition_audit: clean (every persistent name matches "
+          "exactly one rule)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

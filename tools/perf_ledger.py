@@ -58,6 +58,11 @@ TRACKED: Tuple[Tuple[str, str], ...] = (
     ("rank63_doc_iters_per_sec", "rank63 d-it/s"),
     ("serve_rows_per_sec", "serve rows/s"),
     ("valid_row_iters_per_sec", "valid r-it/s"),
+    # fused multi-chip scan blocks (ISSUE 11): widest-mesh fused
+    # row-iters/s and the fused-vs-per-iteration dispatch speedup,
+    # derived from the leg's per-mesh-size multichip_table
+    ("multichip_row_iters_per_sec", "mc r-it/s"),
+    ("multichip_fused_speedup", "mc fused x"),
 )
 ATTRIBUTION_KEYS = ("attribution_device_frac", "attribution_host_gap_frac",
                     "attribution_collective_frac")
@@ -88,6 +93,17 @@ def load_history(root: str) -> List[Dict[str, Any]]:
                 data = json.load(f)
             entry["rc"] = data.get("rc")
             p = data.get("parsed")
+            if isinstance(p, dict):
+                # flatten the multichip table's widest-mesh row into
+                # the tracked flat keys (rows are per mesh size)
+                rows = p.get("multichip_table")
+                if isinstance(rows, list) and rows:
+                    widest = max(rows, key=lambda r: r.get("devices", 0))
+                    p = dict(p)
+                    p.setdefault("multichip_row_iters_per_sec",
+                                 widest.get("row_iters_per_sec"))
+                    p.setdefault("multichip_fused_speedup",
+                                 widest.get("fused_speedup"))
             entry["parsed"] = p if isinstance(p, dict) else None
         except (OSError, ValueError) as exc:
             entry["error"] = f"{type(exc).__name__}: {exc}"
