@@ -226,6 +226,10 @@ def synthetic_leg(n, iters, leaves, max_bin, f=28, seed=0):
     # (docs/GPU-Performance.rst:135-161).  A perf change that breaks
     # learning fails the bench.
     auc = float(_auc(y, np.asarray(bst._gbdt.scores[:, 0])))
+    # canonical model digest (obs/determinism.py): stamped on every
+    # model-training leg so a TPU capture doubles as a cross-host
+    # reproducibility check — same seeds, same digest, any machine
+    phases["model_digest"] = bst._gbdt.digest(include_scores=False)
     # release this leg's device buffers before the next leg allocates
     # (a lingering 1M-leg working set degraded the 10.5M leg ~2x)
     del bst, ds
@@ -318,6 +322,7 @@ def valid_leg(leaves, max_bin, f=28):
               - s0["gbdt.block"] - s0["gbdt.block_compile"])
     evals = s1["gbdt.eval"] - s0["gbdt.eval"]
     auc = float(_auc(y[n:], np.asarray(g._valid_scores[0][:, 0])))
+    digest = g.digest(include_scores=False)
     compile_s = _block_compile_s() - c0
     del bst, ds, vs, g
     import gc
@@ -331,6 +336,7 @@ def valid_leg(leaves, max_bin, f=28):
             "valid_steady_s": round(wall, 3),
             "valid_block_dispatches": int(blocks),
             "valid_evals": int(evals),
+            "valid_model_digest": digest,
             "valid_offblock_iteration_spans": int(it_spans),
             # measured from telemetry over the whole leg (cold train()
             # included): the workflow itself stayed fused
@@ -874,7 +880,8 @@ def _mc_train_rate(ds, y, n, iters, leaves, max_bin, ndev, overlap,
         phases = {"warm_s": round(warm_s, 3),
                   "steady_s": round(wall, 3),
                   "dispatch_gap_mean_s": (round(gap_s / gaps, 6)
-                                          if gaps else None)}
+                                          if gaps else None),
+                  "model_digest": g.digest(include_scores=False)}
         del bst, g
         import gc
         gc.collect()
@@ -1027,6 +1034,7 @@ def multichip_leg(line=None, dryrun: bool = False):
             "auc_ok": bool(auc_on >= AUC_GATE),
             "warm_s": ph_on["warm_s"],
             "steady_s": ph_on["steady_s"],
+            "model_digest": ph_on["model_digest"],
         })
         out["multichip_table"] = table
         out["multichip_parity_ok"] = bool(parity_ok)
@@ -1067,6 +1075,7 @@ def multichip_leg(line=None, dryrun: bool = False):
             "multichip_full_train_auc": round(aucf, 5),
             "multichip_full_warm_s": phf["warm_s"],
             "multichip_full_steady_s": phf["steady_s"],
+            "multichip_full_model_digest": phf["model_digest"],
         })
         del dsf
         gc.collect()
@@ -1301,6 +1310,22 @@ def dryrun_main():
     except Exception as exc:        # noqa: BLE001 - reported on the line
         line["perf_ledger_ok"] = False
         line["perf_ledger_error"] = f"{type(exc).__name__}: {exc}"
+    # model-digest reproducibility gate (ISSUE 12): every model-
+    # training leg stamps `model_digest` (obs/determinism.py canonical
+    # sha256); two toy trainings from identical seeds must agree — the
+    # bench's own train-twice contract, so a TPU BENCH_r* capture
+    # doubles as a cross-host reproducibility artifact (the pending
+    # BENCH_r06 settles cross-host reproducibility for free)
+    try:
+        _, _, ph_a = synthetic_leg(4_000, 4, 15, 15, f=8, seed=0)
+        _, _, ph_b = synthetic_leg(4_000, 4, 15, 15, f=8, seed=0)
+        line["model_digest"] = ph_a["model_digest"]
+        line["model_digest_repeat_ok"] = bool(
+            ph_a["model_digest"]
+            and ph_a["model_digest"] == ph_b["model_digest"])
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["model_digest_repeat_ok"] = False
+        line["model_digest_error"] = f"{type(exc).__name__}: {exc}"
     # per-leg peak_hbm_bytes (ISSUE 8): every leg the dryrun emitted
     # carries the field — a positive int where the backend exposes
     # allocator stats, null + peak_hbm_reason where it doesn't (CPU) —
@@ -1392,9 +1417,11 @@ def ranking_leg(max_bin=255, iters_env="BENCH_RANK_ITERS",
     (_, ndcg10, _), = m.eval(rel, np.asarray(g.scores[:, 0]), None, qb)
     rate = n * iters / wall
     p = "rank" if max_bin == 255 else f"rank{max_bin}"
+    digest = g.digest(include_scores=False)
     del bst, ds, g
     gc.collect()
-    return {f"{p}_docs": n, f"{p}_queries": n_q, f"{p}_iters": iters,
+    return {f"{p}_model_digest": digest,
+            f"{p}_docs": n, f"{p}_queries": n_q, f"{p}_iters": iters,
             f"{p}_max_bin": max_bin,
             f"{p}_compile_s": round(compile_s, 3),
             f"{p}_steady_s": round(wall, 3),
@@ -1493,6 +1520,7 @@ def main():
         "throughput_data": "synthetic HIGGS-shaped",
         "compile_s": ph["compile_s"],
         "steady_s": ph["steady_s"],
+        "model_digest": ph["model_digest"],
     }
     # headline checkpoint: from here on a driver timeout can no longer
     # erase the 1M leg (the driver takes the LAST parseable line)
@@ -1578,6 +1606,7 @@ def main():
                     rps_f / REFERENCE_ROW_ITERS_PER_SEC, 4),
                 "full_compile_s": ph_f["compile_s"],
                 "full_steady_s": ph_f["steady_s"],
+                "full_model_digest": ph_f["model_digest"],
             })
             auc_ok = auc_ok and auc_f_ok
             vs = min(vs, rps_f / REFERENCE_ROW_ITERS_PER_SEC)

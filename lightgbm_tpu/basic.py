@@ -280,6 +280,14 @@ class Booster:
     def num_trees(self):
         return self._gbdt.num_trees()
 
+    def digest(self, include_scores: bool = True) -> str:
+        """Canonical model/score sha256 — the reproducibility contract's
+        unit of comparison (``obs/determinism.py``): identical data +
+        config + seeds must reproduce this digest bit-for-bit.  Pass
+        ``include_scores=False`` to hash the model alone (e.g. after
+        ``free_dataset()`` the score state is gone)."""
+        return self._gbdt.digest(include_scores=include_scores)
+
     # -- evaluation -----------------------------------------------------
     def eval_train(self, feval=None):
         name = getattr(self, "_train_data_name", "training")

@@ -136,6 +136,10 @@ def write_snapshot(gbdt, iteration: int, prefix: Optional[str] = None,
             "best_scores": dict(es.get("best_scores", {})),
             "best_iter": {k: int(v) for k, v in es.get("best_iter", {}).items()},
             "key_order": list(es.get("key_order", [])),
+            # variant bookkeeping beyond trees+scores (DART per-tree
+            # weights): without it a resumed weighted-drop run diverges
+            # from an uninterrupted one even with the keyed drop RNG
+            "extra_state": gbdt.snapshot_extra_state(),
         }
         # manifest LAST: its appearance commits the snapshot
         atomic_write(manifest_path, json.dumps(manifest, indent=1))

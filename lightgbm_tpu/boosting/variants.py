@@ -6,7 +6,8 @@ TPU-native counterparts of the reference subclasses
 """
 from __future__ import annotations
 
-from typing import Optional
+import os
+from typing import Optional, Tuple
 
 import jax.numpy as jnp
 import numpy as np
@@ -15,6 +16,38 @@ from ..config import Config
 from ..learner.serial import build_tree
 from ..utils.log import log_info
 from .gbdt import GBDT
+
+
+def _dart_host_rng() -> bool:
+    """``LGBM_TPU_DART_HOST_RNG=1`` restores the legacy STATEFUL
+    ``np.random.RandomState`` drop stream (pre-PR 12).  The default is
+    the pure ``(drop_seed, iteration)``-keyed derivation below: replay-
+    stable across resume-from-snapshot (the RandomState stream depended
+    on how many draws the dead run had consumed) and rank-identical by
+    construction — the DET001 fix that unblocks multi-process DART
+    (ROADMAP item 5).  The hatch exists for A/B against the legacy
+    stream; parity is pinned by tests/test_determinism.py (registered
+    as the `dart-keyed-vs-host-rng` seam in the detcheck parity
+    registry)."""
+    return os.environ.get("LGBM_TPU_DART_HOST_RNG", "0") == "1"
+
+
+def _drop_uniforms(drop_seed: int, it: int) -> Tuple[float, np.ndarray]:
+    """The keyed drop draws for iteration ``it``: one skip-drop uniform
+    plus ``it`` per-past-iteration uniforms, a pure function of
+    ``(drop_seed, it)`` via ``jax.random.fold_in`` — the same sanctioned
+    idiom as the bagging/feature masks (gbdt.py).  The vector draw is
+    padded to the next power of two so the eager uniform program
+    compiles O(log iterations) times, not per iteration (trace-contract
+    hygiene); the pad values are never read."""
+    import jax
+    key = jax.random.fold_in(jax.random.PRNGKey(drop_seed), it)
+    u_skip = float(jax.random.uniform(jax.random.fold_in(key, 0)))
+    pad = 1
+    while pad < it:
+        pad *= 2
+    u = np.asarray(jax.random.uniform(jax.random.fold_in(key, 1), (pad,)))
+    return u_skip, u[:it]
 
 
 class DART(GBDT):
@@ -31,7 +64,13 @@ class DART(GBDT):
 
     def __init__(self, config: Config, train_set, objective=None, fobj=None):
         super().__init__(config, train_set, objective, fobj)
-        self._rng_drop = np.random.RandomState(config.drop_seed)
+        self._rng_drop = None
+        if _dart_host_rng():
+            # detcheck: disable=DET001 -- legacy escape hatch
+            # (LGBM_TPU_DART_HOST_RNG=1): the stateful pre-PR 12 stream,
+            # kept for A/B against the keyed derivation; NOT replay- or
+            # rank-stable, documented as such in README "Determinism"
+            self._rng_drop = np.random.RandomState(config.drop_seed)
         self._tree_weights: list = []   # per-iteration DART weight
         self._sum_weight = 0.0
 
@@ -95,11 +134,78 @@ class DART(GBDT):
         self._stacked_cache = None
         return False
 
+    def snapshot_extra_state(self) -> dict:
+        # per-tree DART weights: with the keyed drop RNG these are the
+        # ONLY bookkeeping a resume needs beyond trees+scores for a
+        # weighted-drop run to continue bit-for-bit
+        return {"dart_tree_weights": [float(w) for w in self._tree_weights],
+                "dart_sum_weight": float(self._sum_weight)}
+
+    def load_snapshot_extra_state(self, extra: dict) -> None:
+        if "dart_tree_weights" in extra:
+            self._tree_weights = [float(w)
+                                  for w in extra["dart_tree_weights"]]
+            self._sum_weight = float(extra.get("dart_sum_weight", 0.0))
+
     def _select_drop(self) -> np.ndarray:
         """Reference DroppingTrees (dart.hpp:85-125): per-iteration Bernoulli
-        with rate drop_rate (weight-scaled unless uniform_drop)."""
+        with rate drop_rate (weight-scaled unless uniform_drop).
+
+        Default path: draws come from :func:`_drop_uniforms`, pure in
+        ``(drop_seed, self.iter)`` — identical expected drop-count
+        semantics (same Bernoulli rates, same in-order ``max_drop``
+        cap), but byte-stable across resume-from-snapshot and across
+        ranks.  ``LGBM_TPU_DART_HOST_RNG=1`` keeps the legacy stream."""
         c = self.config
         iters = self.iter
+        if self._rng_drop is not None:
+            return self._select_drop_host(iters)
+        if iters == 0:
+            return np.zeros(0, np.int64)
+        from ..obs import determinism
+        determinism.rng_site("dart.drop", "drop_seed/iteration")
+        u_skip, u = _drop_uniforms(c.drop_seed, iters)
+        from ..utils.faults import fault_flag
+        if fault_flag("det.rng_drift"):
+            # injected RNG drift: consume the NEXT iteration's draws in
+            # place of this one's — the silent divergence class the
+            # determinism contract (window digests) must localize
+            u_skip, u = _drop_uniforms(c.drop_seed, iters + 1)
+            u = u[:iters]
+        if u_skip < c.skip_drop:
+            return np.zeros(0, np.int64)
+        return self._drop_from_uniforms(u, iters)
+
+    def _drop_from_uniforms(self, u: np.ndarray, iters: int) -> np.ndarray:
+        c = self.config
+        out = []
+        if not c.uniform_drop and self._sum_weight > 0:
+            inv_avg = len(self._tree_weights) / self._sum_weight
+            rate = c.drop_rate
+            if c.max_drop > 0:
+                rate = min(rate, c.max_drop * inv_avg / self._sum_weight)
+            for i in range(iters):
+                if u[i] < rate * self._tree_weights[i] * inv_avg:
+                    out.append(i)
+                    if c.max_drop > 0 and len(out) >= c.max_drop:
+                        break
+        else:
+            rate = c.drop_rate
+            if c.max_drop > 0:
+                rate = min(rate, c.max_drop / max(1.0, float(iters)))
+            for i in range(iters):
+                if u[i] < rate:
+                    out.append(i)
+                    if c.max_drop > 0 and len(out) >= c.max_drop:
+                        break
+        return np.asarray(out, np.int64)
+
+    def _select_drop_host(self, iters: int) -> np.ndarray:
+        """The pre-PR 12 stream, VERBATIM (escape hatch): sequential
+        ``RandomState`` draws, including the early ``max_drop`` break
+        that stops consuming draws — byte-compatible with models
+        trained before the migration."""
+        c = self.config
         if iters == 0 or self._rng_drop.rand() < c.skip_drop:
             return np.zeros(0, np.int64)
         out = []
@@ -179,6 +285,8 @@ class GOSS(GBDT):
     def train_one_iter(self, grad=None, hess=None) -> bool:
         if grad is None or hess is None:
             grad, hess = self._gradients()
+        from ..obs import determinism
+        determinism.rng_site("goss.sample", "bagging_seed/iteration")
         if self._pr is not None:
             # multi-process: gradients are global row-sharded arrays;
             # the sampling runs as ONE jitted SPMD program (eagerly

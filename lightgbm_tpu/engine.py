@@ -266,20 +266,40 @@ def predict(model, data, num_iteration: int = -1, raw_score: bool = False,
                        pred_contrib=pred_contrib, device=device, **kwargs)
 
 
+def _cv_permutation(seed: int, salt: int, n: int) -> np.ndarray:
+    """Fold-shuffle permutation as a DOCUMENTED pure function of
+    ``(seed, salt)``: a fresh counter-based ``np.random.Philox`` stream
+    keyed by the pair, consumed by exactly one ``permutation`` draw.
+    Unlike the ambient ``RandomState(seed)`` order this replaces, the
+    result cannot depend on how many draws earlier code consumed — the
+    DET001 sequential-consumption hazard — so fold assignments are
+    stable across code motion, resume, and ranks.  Salts: 0 = row/query
+    permutation, ``1000 + class_index`` = per-class stratified shuffle
+    (see :func:`_stratified_folds`)."""
+    gen = np.random.Generator(np.random.Philox(key=[seed, salt]))
+    return gen.permutation(n)
+
+
 def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
        folds=None, nfold: int = 5, stratified: bool = True, shuffle: bool = True,
        metrics=None, fobj=None, feval=None, init_model=None,
        feature_name="auto", categorical_feature="auto",
        early_stopping_rounds=None, fpreproc=None, verbose_eval=None,
        show_stdv: bool = True, seed: int = 0, callbacks=None) -> Dict:
-    """K-fold cross-validation (reference engine.py:312-448)."""
+    """K-fold cross-validation (reference engine.py:312-448).
+
+    Fold shuffling is a pure function of ``seed`` (:func:`_cv_permutation`
+    — hash-based Philox permutation, no ambient RNG order); the
+    assignment for a given ``(seed, n, nfold, stratified)`` is pinned by
+    ``tests/test_determinism.py``."""
     params = canonicalize_params(dict(params or {}))
     if metrics:
         params["metric"] = metrics
     train_set.construct()
     n = train_set.num_data()
     label = np.asarray(train_set.get_label())
-    rng = np.random.RandomState(seed)
+    from .obs import determinism
+    determinism.rng_site("engine.cv_folds", "seed/salt")
 
     if folds is not None:
         fold_list = list(folds.split(np.zeros(n), label)
@@ -290,7 +310,8 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
             # group-aware folds: assign whole queries to folds
             qb = np.asarray(train_set.get_field("group"))
             nq = len(qb) - 1
-            order = rng.permutation(nq) if shuffle else np.arange(nq)
+            order = (_cv_permutation(seed, 0, nq) if shuffle
+                     else np.arange(nq))
             fold_of_q = np.empty(nq, int)
             for i, q in enumerate(order):
                 fold_of_q[q] = i % nfold
@@ -299,9 +320,9 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
                           np.nonzero(row_fold == f)[0]) for f in range(nfold)]
         elif stratified and params.get("objective") in ("binary", "multiclass",
                                                         "multiclassova"):
-            fold_list = _stratified_folds(label, nfold, rng, shuffle)
+            fold_list = _stratified_folds(label, nfold, seed, shuffle)
         else:
-            idx = rng.permutation(n) if shuffle else np.arange(n)
+            idx = _cv_permutation(seed, 0, n) if shuffle else np.arange(n)
             fold_list = [(np.sort(np.concatenate(
                 [idx[j::nfold] for j in range(nfold) if j != f])),
                 np.sort(idx[f::nfold])) for f in range(nfold)]
@@ -353,13 +374,17 @@ def cv(params: Dict[str, Any], train_set: Dataset, num_boost_round: int = 100,
     return dict(results)
 
 
-def _stratified_folds(label, nfold, rng, shuffle):
+def _stratified_folds(label, nfold, seed, shuffle):
+    """Each class's rows shuffle under their OWN ``(seed, 1000+ci)`` key
+    (``ci`` = index into the sorted unique classes), so one class's
+    size can never shift another's draw — per-class assignments are
+    independently stable."""
     classes = np.unique(label)
     test_folds = np.empty(len(label), int)
-    for cls in classes:
+    for ci, cls in enumerate(classes):
         idx = np.nonzero(label == cls)[0]
         if shuffle:
-            idx = rng.permutation(idx)
+            idx = idx[_cv_permutation(seed, 1000 + ci, len(idx))]
         for f in range(nfold):
             test_folds[idx[f::nfold]] = f
     return [(np.nonzero(test_folds != f)[0], np.nonzero(test_folds == f)[0])

@@ -1,0 +1,172 @@
+"""Runtime reproducibility contract (``LGBM_TPU_DETERMINISM=1``).
+
+The static half of the fourth wall is ``tools/detcheck``; this is the
+runtime half, proving at run time what the analyzer argues statically:
+training is a pure function of (data, config, seeds).
+
+Three instruments, all riding existing seams (zero extra collectives,
+near-zero cost when disabled):
+
+* **Canonical digests** — :func:`model_digest` hashes every host tree's
+  canonicalized structural fields plus the f32 score state (sha256).
+  Under the contract, ``GBDT._train`` samples a digest at every window
+  boundary into a ``(iteration, digest)`` ledger; two runs from
+  identical seeds must produce identical ledgers, and the FIRST
+  diverging window localizes when determinism broke (the train-twice
+  harness ``tools/replay_check.py`` automates exactly that
+  comparison).
+* **Cross-rank window check** — on multi-process runs the latest
+  digest rides the SAME early-stopping metric allgather the flight
+  recorder uses; a rank whose model diverged is named, with the
+  window, via a ``det:digest_mismatch`` event (models are replicated
+  state: any mismatch is a determinism bug, full stop).
+* **RNG ledger** — every keyed host-side RNG derivation site calls
+  :func:`rng_site` with its ``(site, key-path)``; the counters land in
+  the ``determinism`` summary section, so a replayed run can assert
+  that not just the outputs but the *derivation traffic* matched.
+
+The ``det.rng_drift`` fault point (``utils/faults.py``) injects a
+mis-keyed derivation (DART consumes the next iteration's draws) to
+prove the ledger trips and names the first diverging window — the same
+proof-by-injection pattern as ``spmd.skip_record`` and ``mem.leak``.
+
+Digest canonicalization (stable across paths and formats, documented
+here as the contract): per tree, in model order —
+``num_leaves``, ``num_cat``, and for the ``num_leaves - 1`` internal
+nodes ``split_feature``, ``threshold`` (f64 bytes), ``decision_type``,
+``left_child``, ``right_child``; the ``num_leaves`` ``leaf_value`` f64
+bytes; the categorical ``cat_boundaries`` / ``cat_threshold`` bitset
+words.  Score state is hashed as f32 bytes in C order.  Deliberately
+EXCLUDED: gain/count diagnostics (reporting, not model) and
+``threshold_bin`` (a binning-dependent cache of ``threshold`` that the
+text format does not persist — the f64 threshold is what routes).
+"""
+from __future__ import annotations
+
+import hashlib
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from . import set_section
+from .telemetry import event as obs_event
+
+__all__ = ["enabled", "reset", "rng_site", "model_digest", "tree_digest",
+           "window_digest", "fingerprint", "window_check", "section"]
+
+
+def enabled() -> bool:
+    return os.environ.get("LGBM_TPU_DETERMINISM", "0") == "1"
+
+
+# ledger state (process-wide, reset per run by GBDT.train / tests)
+_SITES: Dict[str, Dict] = {}
+_DIGESTS: List[Tuple[int, str]] = []
+
+
+def reset() -> None:
+    _SITES.clear()
+    _DIGESTS.clear()
+
+
+def rng_site(site: str, key_path: str, n: int = 1) -> None:
+    """Record ``n`` derivations at a keyed RNG ``site`` whose key is
+    derived along ``key_path`` (e.g. ``"drop_seed/iteration"``).  No-op
+    unless the contract is armed — one dict lookup when off."""
+    if not enabled():
+        return
+    entry = _SITES.setdefault(site, {"key_path": key_path, "count": 0})
+    entry["count"] += n
+
+
+def tree_digest(h, t) -> None:
+    """Feed one host tree's canonical fields into hasher ``h`` (the
+    field list is the module-docstring contract)."""
+    n = int(t.num_leaves)
+    m = max(0, n - 1)
+    h.update(np.int64([n, int(t.num_cat)]).tobytes())
+    h.update(np.ascontiguousarray(t.split_feature[:m], np.int32).tobytes())
+    h.update(np.ascontiguousarray(t.threshold[:m], np.float64).tobytes())
+    h.update(np.ascontiguousarray(t.decision_type[:m], np.int8).tobytes())
+    h.update(np.ascontiguousarray(t.left_child[:m], np.int32).tobytes())
+    h.update(np.ascontiguousarray(t.right_child[:m], np.int32).tobytes())
+    h.update(np.ascontiguousarray(t.leaf_value[:n], np.float64).tobytes())
+    if t.num_cat:
+        h.update(np.asarray(t.cat_boundaries, np.int64).tobytes())
+        h.update(np.asarray(t.cat_threshold, np.uint32).tobytes())
+
+
+def model_digest(gbdt, include_scores: bool = True) -> str:
+    """sha256 hex digest of the booster's canonical model state (every
+    host tree, pending device trees flushed first) plus — when
+    ``include_scores`` and the score state is host-addressable — the
+    running f32 train-score state.  Identical seeds + data + config
+    must yield identical digests at every window; that IS the
+    reproducibility contract."""
+    h = hashlib.sha256()
+    for t in gbdt.models:            # property: flushes pending blocks
+        tree_digest(h, t)
+    if include_scores and getattr(gbdt, "_pr", None) is None \
+            and getattr(gbdt, "scores", None) is not None:
+        h.update(np.ascontiguousarray(
+            np.asarray(gbdt.scores), np.float32).tobytes())
+    return h.hexdigest()
+
+
+def window_digest(gbdt, it: int) -> str:
+    """Sample the digest at a window boundary into the run ledger and
+    refresh the ``determinism`` summary section."""
+    d = model_digest(gbdt, include_scores=getattr(gbdt, "_pr", None) is None)
+    _DIGESTS.append((int(it), d))
+    set_section("determinism", section())
+    return d
+
+
+def fingerprint() -> str:
+    """Latest sampled digest (rides the multi-process ES metric
+    allgather — zero extra collectives)."""
+    return _DIGESTS[-1][1] if _DIGESTS else ""
+
+
+def window_check(fingerprints: List[str], it: int,
+                 rank: Optional[int] = None) -> bool:
+    """Cross-rank digest comparison at a window boundary: the model is
+    replicated state, so ANY mismatch is a determinism bug.  Returns
+    True when consistent; on mismatch emits a ``det:digest_mismatch``
+    event naming the window and the first diverging rank."""
+    if not fingerprints or all(f == fingerprints[0] for f in fingerprints):
+        return True
+    bad = next(i for i, f in enumerate(fingerprints)
+               if f != fingerprints[0])
+    obs_event("det", "digest_mismatch", window_it=int(it),
+              first_diverging_rank=bad,
+              digests=[f[:12] for f in fingerprints])
+    from ..utils.log import log_warning
+    log_warning(f"determinism contract violation at window it={it}: "
+                f"rank {bad} model digest {fingerprints[bad][:12]} != "
+                f"rank 0 {fingerprints[0][:12]}")
+    return False
+
+
+def section() -> Dict:
+    """The ``determinism`` summary section: RNG-ledger counters plus the
+    windowed digest ledger."""
+    return {"sites": {k: dict(v) for k, v in sorted(_SITES.items())},
+            "digests": [[it, d] for it, d in _DIGESTS]}
+
+
+def first_divergence(a: List, b: List) -> Optional[Tuple[int, str, str]]:
+    """Compare two digest ledgers ``[[it, digest], ...]``; None when
+    identical, else ``(window_it, digest_a, digest_b)`` of the FIRST
+    diverging window (a missing window counts as divergence).  This is
+    the replay harness's core comparison (tools/replay_check.py)."""
+    for (ia, da), (ib, db) in zip(a, b):
+        if ia != ib or da != db:
+            return (int(ia), str(da), str(db))
+    if len(a) != len(b):
+        n = min(len(a), len(b))
+        longer = a if len(a) > len(b) else b
+        return (int(longer[n][0]), "<absent>" if len(a) <= n else a[n][1],
+                "<absent>" if len(b) <= n else b[n][1])
+    return None

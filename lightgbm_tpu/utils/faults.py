@@ -26,7 +26,13 @@ the seams where production faults actually strike:
   device array per window into a module-lifetime sink
   (``boosting/gbdt.py``), simulating the live-buffer leak class the
   ``LGBM_TPU_MEM_CONTRACT=1`` watermark gate
-  (``obs/mem_contract.py``) exists to catch.
+  (``obs/mem_contract.py``) exists to catch,
+* ``det.rng_drift``  — a SILENT fault: while armed, DART's keyed drop
+  derivation (``boosting/variants.py``) consumes the NEXT iteration's
+  draws instead of its own — simulating the RNG-divergence class
+  (mis-keyed fold_in, stale seed plumbing) the determinism contract
+  (``obs/determinism.py``, ``LGBM_TPU_DETERMINISM=1``) must catch by
+  naming the first diverging eval window.
 
 Each point is a single ``fault_point(name)`` call that is a no-op unless
 armed.  Tests arm points programmatically (:func:`inject`, or the
@@ -51,7 +57,8 @@ import threading
 from typing import Dict, Optional
 
 POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
-          "loader.read", "spmd.skip_record", "serve.score", "mem.leak")
+          "loader.read", "spmd.skip_record", "serve.score", "mem.leak",
+          "det.rng_drift")
 
 
 class FaultInjected(RuntimeError):

@@ -83,6 +83,9 @@ class RetryPolicy:
         index), jittered."""
         s = min(self.base_s * (2.0 ** attempt), self.max_s)
         if self.jitter > 0:
+            # detcheck: disable=DET001 -- backoff jitter decorrelates
+            # rank retry storms BY DESIGN; the draw shapes only sleep
+            # durations and can never reach model or data state
             s *= 1.0 + self.jitter * random.random()
         return s
 

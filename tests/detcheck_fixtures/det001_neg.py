@@ -1,0 +1,21 @@
+"""DET001 negatives: the sanctioned derivation idioms."""
+import jax
+import numpy as np
+
+
+def bag_mask(seed, epoch, n, fraction):
+    # pure (seed, step)-keyed device derivation (the gbdt.py idiom)
+    key = jax.random.fold_in(jax.random.PRNGKey(seed), epoch)
+    return jax.random.uniform(key, (n,)) < fraction
+
+
+def single_draw_sample(seed, n, k):
+    # a fresh seeded generator consumed by exactly ONE draw is pure
+    rng = np.random.RandomState(seed)
+    return np.sort(rng.choice(n, k, replace=False))
+
+
+def keyed_permutation(seed, salt, n):
+    # counter-based Philox keyed by (seed, salt): the host-side analog
+    gen = np.random.Generator(np.random.Philox(key=[seed, salt]))
+    return gen.permutation(n)

@@ -1,0 +1,21 @@
+"""DET001 positives: stateful, global, and sequential host RNG."""
+import numpy as np
+
+
+class Booster:
+    def __init__(self, seed):
+        self._rng = np.random.RandomState(seed)  # EXPECT: DET001
+
+    def sample(self, n):
+        return self._rng.rand(n)
+
+
+def global_draw(n):
+    return np.random.rand(n)  # EXPECT: DET001
+
+
+def sequential(seed, n):
+    rng = np.random.RandomState(seed)  # EXPECT: DET001
+    first = rng.permutation(n)
+    second = rng.permutation(n)
+    return first, second

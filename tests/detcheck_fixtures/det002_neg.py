@@ -1,0 +1,29 @@
+"""DET002 negatives: per-site subkeys; mutually exclusive branches."""
+import jax
+
+
+def per_site(seed, n):
+    key = jax.random.PRNGKey(seed)
+    noise = jax.random.uniform(jax.random.fold_in(key, 0), (n,))
+    key2 = jax.random.fold_in(key, 1)
+    jitter = jax.random.normal(key2, (n,))
+    return noise + jitter
+
+
+def refolded(seed, n):
+    key = jax.random.PRNGKey(seed)
+    a = jax.random.uniform(key, (n,))
+    key = jax.random.fold_in(key, 1)
+    b = jax.random.uniform(key, (n,))
+    return a + b
+
+
+def exclusive(seed, n, layout):
+    # the GOSS pattern: both arms draw from the SAME key on purpose so
+    # distributed and serial runs sample the identical row set
+    key = jax.random.PRNGKey(seed)
+    if layout is None:
+        r = jax.random.uniform(key, (n,))
+    else:
+        r = jax.random.uniform(key, (n + 1,))[layout]
+    return r

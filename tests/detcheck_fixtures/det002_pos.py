@@ -1,0 +1,9 @@
+"""DET002 positive: one key consumed by two sampler sites."""
+import jax
+
+
+def correlated(seed, n):
+    key = jax.random.PRNGKey(seed)
+    noise = jax.random.uniform(key, (n,))
+    jitter = jax.random.normal(key, (n,))  # EXPECT: DET002
+    return noise + jitter

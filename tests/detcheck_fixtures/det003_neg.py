@@ -1,0 +1,11 @@
+"""DET003 negatives: sorted sets and membership tests are fine."""
+
+
+def feature_order(names):
+    used = set(names)
+    return sorted(used)
+
+
+def keep_known(bins, wanted):
+    lookup = set(wanted)
+    return [b for b in bins if b in lookup]

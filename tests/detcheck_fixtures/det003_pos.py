@@ -1,0 +1,13 @@
+"""DET003 positives: iterating sets."""
+
+
+def feature_order(names):
+    used = set(names)
+    return [n for n in used]  # EXPECT: DET003
+
+
+def collect(bins):
+    out = []
+    for b in {int(v) for v in bins}:  # EXPECT: DET003
+        out.append(b)
+    return out

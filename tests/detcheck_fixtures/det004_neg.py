@@ -1,0 +1,8 @@
+"""DET004 negative: the in-file tie-break contract declaration."""
+import jax.numpy as jnp
+
+TIE_BREAK_CONTRACT = "tests/test_detcheck.py"
+
+
+def best_split(gain):
+    return jnp.argmax(gain, axis=-1)

@@ -1,0 +1,6 @@
+"""DET004 positive: split-selection argmax, no tie-break contract."""
+import jax.numpy as jnp
+
+
+def best_split(gain):
+    return jnp.argmax(gain, axis=-1)  # EXPECT: DET004

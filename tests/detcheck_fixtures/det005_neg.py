@@ -1,0 +1,17 @@
+"""DET005 negative: a REGISTERED seam (parity test pinned in
+tools/detcheck/parity_registry.py) branches freely."""
+import os
+
+import jax
+
+
+def overlap_enabled():
+    # registered: PROGRAM_PAIRS `overlapped-vs-serial-psum` ->
+    # tests/test_overlap.py
+    return os.environ.get("LGBM_TPU_OVERLAP", "1") != "0"
+
+
+def run(x):
+    if overlap_enabled():
+        return jax.jit(lambda v: v + 1.0)(x)
+    return x + 1.0

@@ -1,0 +1,14 @@
+"""DET005 positive: env-gated dual program path, no parity gate."""
+import os
+
+import jax
+
+
+def _fast_path_enabled():
+    return os.environ.get("LGBM_TPU_FIXTURE_FAST", "1") != "0"  # EXPECT: DET005
+
+
+def run(x):
+    if _fast_path_enabled():
+        return jax.jit(lambda v: v * 2.0)(x)
+    return x * 2.0

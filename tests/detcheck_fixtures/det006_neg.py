@@ -1,0 +1,12 @@
+"""DET006 negative: host-side reads, values passed in as operands."""
+import os
+import time
+
+import jax
+
+
+def launch(x):
+    t0 = time.time()
+    scale = float(os.environ.get("LGBM_TPU_FIXTURE_SCALE", "1"))
+    y = jax.jit(lambda v, s: v * s)(x, scale)
+    return y, time.time() - t0

@@ -1,0 +1,17 @@
+"""DET006 positives: trace-time clock/env reads bake into the
+compiled program."""
+import os
+import time
+
+import jax
+
+
+@jax.jit
+def stamped(x):
+    return x * time.time()  # EXPECT: DET006
+
+
+@jax.jit
+def env_scaled(x):
+    scale = float(os.environ.get("LGBM_TPU_FIXTURE_SCALE", "1"))  # EXPECT: DET006
+    return x * scale

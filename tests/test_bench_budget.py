@@ -72,6 +72,9 @@ def test_headline_line_survives_simulated_timeout(bench_run):
     assert first["value"] is not None and first["value"] > 0
     assert "vs_baseline" in first
     assert first.get("partial") == "headline-1M"
+    # ISSUE 12: the headline leg stamps its canonical model digest
+    assert isinstance(first.get("model_digest"), str) \
+        and len(first["model_digest"]) == 64
 
 
 def test_headline_carries_peak_hbm_field(bench_run):
@@ -223,6 +226,18 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
         "perf_ledger_error", out.get("perf_ledger_regressions"))
     assert set(out["perf_ledger_rounds"]) >= {1, 2, 3, 4, 5}
     assert out["perf_ledger_parsed_rounds"], out
+    # model-digest reproducibility gate (ISSUE 12): every model-
+    # training leg stamps the canonical sha256 (obs/determinism.py) and
+    # two toy trainings from identical seeds agree — the bench's own
+    # train-twice contract, so a TPU BENCH_r* capture settles
+    # cross-host reproducibility for free
+    assert out["model_digest_repeat_ok"] is True, out.get(
+        "model_digest_error")
+    assert isinstance(out["model_digest"], str) \
+        and len(out["model_digest"]) == 64
+    for row in out["multichip_table"]:
+        assert isinstance(row["model_digest"], str) \
+            and len(row["model_digest"]) == 64
     # per-leg memory column (ISSUE 8): every dryrun leg carries
     # peak_hbm_bytes — int > 0 with allocator stats, else null + reason
     assert out["peak_hbm_schema_ok"] is True, out

@@ -1,0 +1,255 @@
+"""Runtime reproducibility contract (ISSUE 12).
+
+* train-twice digest identity (serial / bagged / 2-shard mesh / DART /
+  GOSS) through the ``tools/replay_check.py`` harness, in-process on
+  the virtual CPU mesh;
+* the injected ``det.rng_drift`` fault TRIPS the contract, first
+  diverging window named;
+* RNG-ledger counters land in the ``determinism`` summary section;
+* the DART drop-RNG migration: keyed draws are pure (call-order and
+  resume independent), the ``LGBM_TPU_DART_HOST_RNG=1`` escape hatch
+  reproduces the legacy ``RandomState`` stream VERBATIM (the
+  before/after-migration A/B), and a resumed keyed-DART run is
+  byte-identical to an uninterrupted one;
+* CV fold shuffling is a pure function of ``seed`` with per-class
+  stream independence.
+"""
+import os
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import determinism
+from lightgbm_tpu.utils import faults
+
+import tools.replay_check as rc
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _toy(n=300, f=5, seed=11):
+    gen = np.random.Generator(np.random.Philox(key=[seed, 0]))
+    X = gen.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + 0.4 * gen.normal(size=n) > 0).astype(np.float64)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# train-twice digest identity (the replay harness, in-process)
+# ---------------------------------------------------------------------------
+@pytest.mark.parametrize("scenario",
+                         ["serial", "bagged", "mesh2", "dart", "goss"])
+def test_train_twice_digest_identity(scenario, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_DETERMINISM", "1")
+    ok, msg = rc.check_scenario(scenario, rows=300, rounds=6)
+    assert ok, msg
+
+
+def test_injected_rng_drift_trips_naming_window(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_DETERMINISM", "1")
+    ok, msg = rc.drift_proof(rows=300, rounds=6, drift_at=2)
+    assert ok, msg
+    assert "window it=" in msg, msg
+
+
+def test_fault_point_registered():
+    assert "det.rng_drift" in faults.POINTS
+
+
+# ---------------------------------------------------------------------------
+# ledger + digest plumbing
+# ---------------------------------------------------------------------------
+def test_rng_ledger_lands_in_summary(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_DETERMINISM", "1")
+    obs.reset()
+    obs.enable()
+    X, y = _toy()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbose": -1,
+                     "bagging_fraction": 0.7, "bagging_freq": 1,
+                     "feature_fraction": 0.8},
+                    lgb.Dataset(X, label=y), num_boost_round=4,
+                    verbose_eval=False)
+    sec = determinism.section()
+    assert "gbdt.bag_mask" in sec["sites"], sec["sites"]
+    assert "gbdt.feature_mask" in sec["sites"]
+    assert sec["sites"]["gbdt.bag_mask"]["key_path"] == \
+        "bagging_seed/epoch"
+    assert sec["sites"]["gbdt.bag_mask"]["count"] >= 4
+    assert sec["digests"], "no window digests sampled"
+    # ... and the section rides the telemetry summary (merged summaries
+    # carry rank 0's sections, so this is what multi-process sees too)
+    assert obs.summary().get("determinism", {}).get("digests") \
+        == sec["digests"]
+    assert bst.digest()  # Booster surface
+
+
+def test_digest_survives_text_roundtrip():
+    X, y = _toy()
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "min_data_in_leaf": 5, "verbose": -1},
+                    lgb.Dataset(X, label=y), num_boost_round=4,
+                    verbose_eval=False)
+    d = bst.digest(include_scores=False)
+    reloaded = lgb.Booster(model_str=bst.model_to_string())
+    assert reloaded.digest(include_scores=False) == d
+
+
+def test_window_check_unit():
+    assert determinism.window_check(["a", "a", "a"], it=2)
+    obs.reset()
+    obs.enable()
+    assert not determinism.window_check(["a", "a", "b"], it=4)
+    assert obs.summary()["events"].get("det:digest_mismatch") == 1
+
+
+def test_first_divergence():
+    a = [[2, "x"], [4, "y"], [6, "z"]]
+    assert determinism.first_divergence(a, list(a)) is None
+    div = determinism.first_divergence(a, [[2, "x"], [4, "q"], [6, "z"]])
+    assert div == (4, "y", "q")
+    div = determinism.first_divergence(a, a[:2])
+    assert div is not None and div[0] == 6
+
+
+# ---------------------------------------------------------------------------
+# DART drop-RNG migration
+# ---------------------------------------------------------------------------
+def _mk_dart(monkeypatch, host_rng, **over):
+    from lightgbm_tpu.boosting.variants import DART
+    from lightgbm_tpu.config import Config
+    monkeypatch.setenv("LGBM_TPU_DART_HOST_RNG", "1" if host_rng else "0")
+    params = {"objective": "binary", "boosting": "dart",
+              "drop_rate": 0.4, "skip_drop": 0.2, "drop_seed": 4,
+              "verbose": -1, **over}
+    return DART(Config.from_params(params), None)
+
+
+def test_keyed_drop_is_pure_and_order_independent(monkeypatch):
+    a = _mk_dart(monkeypatch, host_rng=False, uniform_drop=True)
+    b = _mk_dart(monkeypatch, host_rng=False, uniform_drop=True)
+    a.iter, b.iter = 5, 5
+    drops = a._select_drop()
+    assert np.array_equal(drops, a._select_drop())        # repeatable
+    assert np.array_equal(drops, b._select_drop())        # instance-free
+    # querying other iterations first must not shift iteration 5's draw
+    c = _mk_dart(monkeypatch, host_rng=False, uniform_drop=True)
+    for it in (7, 2, 9):
+        c.iter = it
+        c._select_drop()
+    c.iter = 5
+    assert np.array_equal(drops, c._select_drop())
+
+
+def test_escape_hatch_reproduces_legacy_stream(monkeypatch):
+    """The before/after-migration A/B: under LGBM_TPU_DART_HOST_RNG=1
+    the drop sequence is byte-identical to the pre-PR 12 RandomState
+    code (replicated verbatim here), sequential consumption, early
+    max_drop break and all."""
+    d = _mk_dart(monkeypatch, host_rng=True, uniform_drop=True,
+                 max_drop=2)
+    rng = np.random.RandomState(4)          # the legacy stream
+    c = d.config
+    for it in range(1, 12):
+        d.iter = it
+        got = d._select_drop()
+        # verbatim pre-migration algorithm (uniform_drop path)
+        if rng.rand() < c.skip_drop:
+            want = []
+        else:
+            rate = min(c.drop_rate, c.max_drop / max(1.0, float(it)))
+            want = []
+            for i in range(it):
+                if rng.rand() < rate:
+                    want.append(i)
+                    if len(want) >= c.max_drop:
+                        break
+        assert got.tolist() == want, (it, got.tolist(), want)
+
+
+def test_keyed_drop_semantics_match_expected_rate(monkeypatch):
+    """Same expected drop-count semantics: over many iterations the
+    keyed Bernoulli accepts ~drop_rate of past trees (uniform path,
+    no cap, skip_drop=0)."""
+    d = _mk_dart(monkeypatch, host_rng=False, uniform_drop=True,
+                 skip_drop=0.0, drop_rate=0.3, max_drop=-1)
+    total = picked = 0
+    for it in range(1, 120):
+        d.iter = it
+        picked += len(d._select_drop())
+        total += it
+    rate = picked / total
+    assert 0.25 < rate < 0.35, rate
+
+
+def test_dart_resume_byte_identical(tmp_path, monkeypatch):
+    """ISSUE 12 acceptance: a keyed-DART run resumed from a snapshot is
+    byte-identical to an uninterrupted one (the legacy stateful stream
+    could not be: its position depended on consumed draw count, which a
+    resume reset)."""
+    monkeypatch.delenv("LGBM_TPU_DART_HOST_RNG", raising=False)
+    X, y = _toy(n=400)
+    params = {"objective": "binary", "boosting": "dart", "num_leaves": 7,
+              "min_data_in_leaf": 5, "drop_rate": 0.5, "skip_drop": 0.2,
+              "drop_seed": 4, "verbose": -1}
+    straight = lgb.train(dict(params), lgb.Dataset(X, label=y),
+                         num_boost_round=8, verbose_eval=False)
+    prefix = str(tmp_path / "dart_snap")
+    lgb.train(dict(params, snapshot_freq=4, output_model=prefix),
+              lgb.Dataset(X, label=y), num_boost_round=4,
+              verbose_eval=False)
+    resumed = lgb.train(dict(params, output_model=prefix),
+                        lgb.Dataset(X, label=y), num_boost_round=8,
+                        resume_from=prefix, verbose_eval=False)
+    assert resumed.model_to_string() == straight.model_to_string()
+    assert resumed.digest(include_scores=False) == \
+        straight.digest(include_scores=False)
+
+
+# ---------------------------------------------------------------------------
+# CV fold shuffling: pure in seed, per-class independent
+# ---------------------------------------------------------------------------
+def test_cv_permutation_pure():
+    from lightgbm_tpu.engine import _cv_permutation
+    a = _cv_permutation(3, 0, 64)
+    assert np.array_equal(a, _cv_permutation(3, 0, 64))
+    assert sorted(a.tolist()) == list(range(64))
+    assert not np.array_equal(a, _cv_permutation(3, 1, 64))
+    assert not np.array_equal(a, _cv_permutation(4, 0, 64))
+
+
+def test_stratified_folds_stable_and_class_independent():
+    from lightgbm_tpu.engine import _stratified_folds
+    y = np.array([0, 1] * 30 + [1] * 10, float)
+    f1 = _stratified_folds(y, 3, seed=5, shuffle=True)
+    f2 = _stratified_folds(y, 3, seed=5, shuffle=True)
+    for (tr1, va1), (tr2, va2) in zip(f1, f2):
+        assert np.array_equal(tr1, tr2) and np.array_equal(va1, va2)
+    # per-class keyed streams: growing class 1 must not reshuffle
+    # class 0's assignment (the ambient-RandomState failure mode)
+    y_grown = np.concatenate([y, np.ones(17)])
+    f3 = _stratified_folds(y_grown, 3, seed=5, shuffle=True)
+    class0 = np.nonzero(y == 0)[0]
+    fold_of = {}
+    for f, (_, va) in enumerate(f1):
+        for i in va:
+            fold_of[i] = f
+    fold_of3 = {}
+    for f, (_, va) in enumerate(f3):
+        for i in va:
+            fold_of3[i] = f
+    for i in class0:
+        assert fold_of[i] == fold_of3[i]
+
+
+def test_cv_runs_and_is_repeatable():
+    X, y = _toy(n=240)
+    params = {"objective": "binary", "metric": "auc", "num_leaves": 7,
+              "min_data_in_leaf": 5, "verbose": -1}
+    r1 = lgb.cv(params, lgb.Dataset(X, label=y), num_boost_round=3,
+                nfold=3, seed=9)
+    r2 = lgb.cv(params, lgb.Dataset(X, label=y), num_boost_round=3,
+                nfold=3, seed=9)
+    assert r1 == r2

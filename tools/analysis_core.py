@@ -1,23 +1,27 @@
 """Shared plumbing for the repo's static analyzers (tpulint, spmdcheck,
-memcheck): file loading, one process-wide AST cache, inline suppression
-parsing, the content-keyed baseline, and the fixture EXPECT matcher.
+memcheck, detcheck): file loading, one process-wide AST cache, inline
+suppression parsing, the content-keyed baseline, and the fixture EXPECT
+matcher.
 
 History: this started life as ``tools/tpulint/core.py`` (PR 3) and was
 imported wholesale by spmdcheck (PR 4).  With memcheck as the third
-consumer the plumbing moves here; ``tools/tpulint/core.py`` remains as
-a re-export shim so existing imports keep working.
+consumer the plumbing moved here (``tools/tpulint/core.py`` remains a
+re-export shim so existing imports keep working); detcheck (PR 12) is
+the fourth rider.
 
 Design invariants every analyzer relies on:
 
 * **One parse per file per process** — ASTs are cached on
-  ``(path, mtime, size)``; running tpulint + spmdcheck + memcheck in one
-  process (``python -m tools.check``, or the three tier-1 gate tests in
-  one pytest session) parses each package file exactly once.
+  ``(path, mtime, size)``; running tpulint + spmdcheck + memcheck +
+  detcheck in one process (``python -m tools.check``, or the four
+  tier-1 gate tests in one pytest session) parses each package file
+  exactly once.
 * **Suppression syntax** is shared across analyzers, keyed by tag::
 
       x = np.asarray(v)  # tpulint: disable=TPL003 -- host-only IO path
       y = jax.lax.psum(y, ax)  # spmdcheck: disable=SPM001 -- masked
       _SINK.append(a)  # memcheck: disable=MEM005 -- bounded by tests
+      s *= 1 + j * random.random()  # detcheck: disable=DET001 -- jitter
 
   A disable comment applies to its own line, or — when the line is
   comment-only — to the next source line.  A disable WITHOUT a
@@ -42,7 +46,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 # rule ids (rule-id sets are disjoint, so cross-tag suppression is
 # harmless and occasionally handy when one line trips two analyzers)
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:tpulint|spmdcheck|memcheck):\s*disable="
+    r"#\s*(?:tpulint|spmdcheck|memcheck|detcheck):\s*disable="
     r"([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?\s*$")
 
 # fixture EXPECT markers (tests): `# EXPECT: TPL001` on the flagged

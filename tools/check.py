@@ -1,16 +1,16 @@
 """Umbrella static gate: ``python -m tools.check [--root R] [paths...]``.
 
-Runs all three analyzers — tpulint (TPL000-TPL008), spmdcheck
-(SPM001-SPM004), memcheck (MEM001-MEM005) — over ONE shared AST parse
-(``tools/analysis_core.py``'s process-wide cache: each file is parsed
-exactly once no matter how many analyzers visit it) and diffs each
-against its own committed baseline.  Exit 0 = all clean, 1 = any new
-finding, 2 = usage error.
+Runs all four analyzers — tpulint (TPL000-TPL008), spmdcheck
+(SPM001-SPM004), memcheck (MEM001-MEM005), detcheck (DET001-DET006) —
+over ONE shared AST parse (``tools/analysis_core.py``'s process-wide
+cache: each file is parsed exactly once no matter how many analyzers
+visit it) and diffs each against its own committed baseline.  Exit 0 =
+all clean, 1 = any new finding, 2 = usage error.
 
 This is what the tier-1 gate tests call (``tests/test_tpulint.py`` /
-``test_spmdcheck.py`` / ``test_memcheck.py`` share one in-process
-:func:`cached_run_all`), and the one command a developer needs before
-pushing::
+``test_spmdcheck.py`` / ``test_memcheck.py`` / ``test_detcheck.py``
+share one in-process :func:`cached_run_all`), and the one command a
+developer needs before pushing::
 
     python -m tools.check
 
@@ -32,8 +32,9 @@ def run_all(paths: Sequence[str] = ("lightgbm_tpu",),
             root: Optional[str] = None,
             project_rules: bool = True,
             ) -> Dict[str, Tuple[List[Finding], List[Finding]]]:
-    """Run the three analyzers over one parse; -> name ->
+    """Run the four analyzers over one parse; -> name ->
     (all_findings, new_vs_baseline)."""
+    from tools.detcheck import (BASELINE_DEFAULT as DET_BL, run_detcheck)
     from tools.memcheck import (BASELINE_DEFAULT as MEM_BL, run_memcheck)
     from tools.spmdcheck import (BASELINE_DEFAULT as SPM_BL, run_spmdcheck)
     from tools.tpulint import (BASELINE_DEFAULT as TPL_BL, run_lint)
@@ -47,7 +48,11 @@ def run_all(paths: Sequence[str] = ("lightgbm_tpu",),
             ("memcheck",
              lambda: run_memcheck(paths, root=root,
                                   project_rules=project_rules),
-             MEM_BL)):
+             MEM_BL),
+            ("detcheck",
+             lambda: run_detcheck(paths, root=root,
+                                  project_rules=project_rules),
+             DET_BL)):
         findings, by_rel = runner()
         baseline = load_baseline(os.path.join(root, bl))
         out[name] = (findings, new_findings(findings, by_rel, baseline))
@@ -72,7 +77,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.check",
         description="combined static gate: tpulint + spmdcheck + "
-                    "memcheck over one shared AST parse")
+                    "memcheck + detcheck over one shared AST parse")
     parser.add_argument("paths", nargs="*", default=["lightgbm_tpu"])
     parser.add_argument("--root", default=None,
                         help="project root (default: cwd)")

@@ -1,0 +1,79 @@
+"""detcheck — determinism & numerics analyzer.
+
+The fourth static gate (after tpulint, spmdcheck, memcheck), aimed at
+the property every bit-exactness test silently assumes: training and
+serving are pure functions of (data, config, seeds).  Rules
+DET001-DET006 (see ``rules.py``) run as a tier-1 gate via
+``tests/test_detcheck.py`` / ``python -m tools.check`` and by hand::
+
+    python -m tools.detcheck [--update-baseline] [--registry] [paths...]
+
+Shares the analyzer plumbing in ``tools/analysis_core.py`` (one AST
+parse per file per process, ``# detcheck: disable=DETxxx -- why``
+suppressions, content-keyed baseline — committed EMPTY).  The
+declarative contract lives in ``parity_registry.py`` (program-pair →
+pinning test; tie-break contracts; exempted knobs).  The RUNTIME half
+is the reproducibility contract (``lightgbm_tpu/obs/determinism.py``,
+``LGBM_TPU_DETERMINISM=1``) and the train-twice replay harness
+(``tools/replay_check.py``); this package only analyzes source.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.analysis_core import (FileInfo, Finding, discover_files,
+                                 load_baseline, new_findings, suppressed,
+                                 write_baseline)
+
+from .rules import FILE_RULES, PROJECT_RULES, RULE_TITLES, build_context
+
+BASELINE_DEFAULT = os.path.join("tools", "detcheck", "baseline.json")
+
+__all__ = [
+    "run_detcheck", "Finding", "RULE_TITLES", "load_baseline",
+    "write_baseline", "new_findings", "BASELINE_DEFAULT",
+]
+
+
+def run_detcheck(paths: Sequence[str] = ("lightgbm_tpu",),
+                 root: Optional[str] = None,
+                 project_rules: bool = True,
+                 ) -> Tuple[List[Finding], Dict[str, FileInfo]]:
+    """Analyze ``paths``; returns (findings sorted by location, FileInfo
+    by relative path).  Inline suppressions applied; the baseline is NOT
+    — callers diff via :func:`new_findings` (same contract as the other
+    three analyzers).  ``project_rules=False`` skips the registry-
+    soundness project rule for fixture runs."""
+    root = os.path.abspath(root or os.getcwd())
+    files = discover_files(paths, root)
+    ctx = build_context(files, root, project_rules=project_rules)
+    findings: List[Finding] = []
+    for fi in files:
+        for rule in FILE_RULES:
+            for f in rule(fi, ctx):
+                if not suppressed(fi, f):
+                    findings.append(f)
+    if project_rules:
+        for rule in PROJECT_RULES:
+            findings.extend(rule(ctx))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings, ctx.by_rel
+
+
+def render_registry() -> List[str]:
+    """Human-readable registry dump (the ``--registry`` CLI view)."""
+    from . import parity_registry as reg
+    lines = ["program seams (env -> pinning test):"]
+    for e in reg.PROGRAM_PAIRS:
+        mark = "" if reg.test_exists(e["test"]) else "  [MISSING TEST]"
+        lines.append(f"  {e['env']:<28} {e['test']}{mark}")
+    lines.append("exempt env knobs:")
+    for env in sorted(reg.EXEMPT_ENV):
+        lines.append(f"  {env:<28} {reg.EXEMPT_ENV[env]}")
+    lines.append("tie-break contracts:")
+    for rel in sorted(reg.TIE_BREAK):
+        e = reg.TIE_BREAK[rel]
+        what = e.get("test") or f"exempt: {e.get('exempt')}"
+        lines.append(f"  {rel:<34} {what}")
+    return lines

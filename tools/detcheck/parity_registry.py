@@ -1,0 +1,225 @@
+"""The declarative parity/contract registry detcheck checks against.
+
+PR 11's hard-won lesson was that two XLA programs computing the "same"
+logic are only byte-identical when a TEST pins them together — chasing
+cross-program FMA-contraction parity analytically is unwinnable.  This
+file turns that lesson into a checked contract: every DUAL-PATH SEAM
+(an env flag selecting between traced programs) and every ORDER-
+SENSITIVE SELECTION (argmax/top_k in split-selection or serve code)
+must either name the test that pins its parity / tie-break behavior,
+or carry an explicit exemption with the argument why no gate is
+needed.  A new seam that registers nothing is a DET004/DET005 finding.
+
+Three tables:
+
+* :data:`PROGRAM_PAIRS` — env-flag seams that select between traced
+  programs, each mapped to the pinning test (DET005).  ``programs`` is
+  documentation: the two (or more) compiled paths the flag chooses
+  between.
+* :data:`EXEMPT_ENV` — env knobs that look like seams to the analyzer
+  (they gate branches in jit-bearing modules) but do NOT select
+  between parity-relevant programs; each carries its why (DET005).
+* :data:`TIE_BREAK` — modules whose ``argmax``/``argmin``/``top_k``
+  calls decide model structure or served output, mapped to the test
+  pinning the first-max tie-break (DET004).  A module can instead
+  declare ``TIE_BREAK_CONTRACT = "<test path>"`` at module scope —
+  the in-file form of the same registration.
+
+Registered test paths are resolved against the REPO root (where this
+tools/ package lives), not the analyzed root, so seeded-hazard tests
+that copy ``lightgbm_tpu/`` into a temp dir still validate against the
+real test suite.  A registered test whose file does not exist is itself
+a finding (the gate rotted).
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Tuple
+
+# repo root = parent of tools/ (this file lives in tools/detcheck/)
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+# -- DET005: env-flag program seams --------------------------------------
+PROGRAM_PAIRS: Tuple[Dict, ...] = (
+    {"name": "mesh-fused-vs-per-iteration",
+     "env": "LGBM_TPU_MESH_BLOCK",
+     "programs": ("fused lax.scan mesh block (one dispatch per window)",
+                  "length-1 blocks of the same compiled body"),
+     "test": "tests/test_mesh_block.py"},
+    {"name": "block-vs-legacy-eager",
+     "env": "LGBM_TPU_NO_BLOCK",
+     "programs": ("fused scan-block training loop",
+                  "legacy eager per-iteration loop"),
+     "test": "tests/test_block_valid.py"},
+    {"name": "fused-block-vs-per-iteration-serial",
+     "env": "LGBM_TPU_NO_FUSED",
+     "programs": ("fused 32-iteration serial block",
+                  "per-iteration serial dispatches"),
+     "test": "tests/test_block_valid.py"},
+    {"name": "split-cache-vs-full-rescan",
+     "env": "LGBM_TPU_SPLIT_CACHE",
+     "programs": ("incremental per-leaf split cache (O(new children))",
+                  "full O(L*F*B) per-wave rescan"),
+     "test": "tests/test_split_cache.py"},
+    {"name": "pallas-split-kernel-vs-xla-scan",
+     "env": "LGBM_TPU_SPLIT_KERNEL",
+     "programs": ("fused Pallas split kernel",
+                  "chunked XLA scan split finder"),
+     "test": "tests/test_pallas_split.py"},
+    {"name": "split-kernel-interpret-vs-compiled",
+     "env": "LGBM_TPU_SPLIT_INTERPRET",
+     "programs": ("Pallas split kernel, interpret mode",
+                  "Pallas split kernel, compiled"),
+     "test": "tests/test_pallas_split.py"},
+    {"name": "hist-backend-selection",
+     "env": "LGBM_TPU_HIST_BACKEND",
+     "programs": ("scatter histogram", "wide fused Pallas kernel",
+                  "leaf-compacted Pallas kernel"),
+     "test": "tests/test_compact.py"},
+    {"name": "compact-vs-wide-kernel",
+     "env": "LGBM_TPU_NO_COMPACT",
+     "programs": ("leaf-compacted deep-wave histograms",
+                  "wide fused route+hist kernel"),
+     "test": "tests/test_compact.py"},
+    {"name": "hist-mode-precision",
+     "env": "LGBM_TPU_HIST_MODE",
+     "programs": ("f32 histogram accumulation",
+                  "bf16/int8h accumulation modes"),
+     "test": "tests/test_hist_parity.py"},
+    {"name": "donation-on-vs-off",
+     "env": "LGBM_TPU_DONATE",
+     "programs": ("score/grad/hess buffers donated in place",
+                  "undonated dispatches"),
+     "test": "tests/test_overlap.py"},
+    {"name": "overlapped-vs-serial-psum",
+     "env": "LGBM_TPU_OVERLAP",
+     "programs": ("chunked double-buffered wave psum",
+                  "single serial psum per wave"),
+     "test": "tests/test_overlap.py"},
+    {"name": "overlap-chunking",
+     "env": "LGBM_TPU_OVERLAP_CHUNKS",
+     "programs": ("N-chunk overlapped psum schedules (N >= 1)",),
+     "test": "tests/test_overlap.py"},
+    {"name": "phases-driver-vs-fused-build",
+     "env": "LGBM_TPU_TIMETAG",
+     "programs": ("unfused per-phase-timed wave driver",
+                  "single jitted tree build"),
+     "test": "tests/test_learner.py"},
+    {"name": "lean-vs-padded-compile-shapes",
+     "env": "LGBM_TPU_COMPILE_LEAN_ROWS",
+     "programs": ("row-lean compile shapes", "padded compile shapes"),
+     "test": "tests/test_consistency.py"},
+    {"name": "device-vs-host-serve-scorer",
+     "env": "LGBM_TPU_PREDICT_DEVICE",
+     "programs": ("TPU-resident tensorized scorer (serve/compiler.py)",
+                  "host numpy tree walk"),
+     "test": "tests/test_serve.py"},
+    {"name": "capi-device-vs-host-predict",
+     "env": "LGBM_TPU_CAPI_DEVICE",
+     "programs": ("C-API predict through the device scorer",
+                  "C-API predict through the host walk"),
+     "test": "tests/test_c_api.py"},
+    {"name": "dart-keyed-vs-host-rng",
+     "env": "LGBM_TPU_DART_HOST_RNG",
+     "programs": ("pure (drop_seed, iteration)-keyed drop derivation",
+                  "legacy stateful np.random.RandomState stream"),
+     "test": "tests/test_determinism.py"},
+)
+
+# knobs that branch inside jit-bearing modules but do not choose
+# between parity-relevant traced programs — each with its argument
+EXEMPT_ENV: Dict[str, str] = {
+    "LGBM_TPU_PROFILE": "observability: windowed profiler capture; the "
+                        "captured programs are the ones already running",
+    "LGBM_TPU_PROFILE_WINDOWS": "profiler capture length knob",
+    "LGBM_TPU_PROFILE_ITERS": "profiler capture length knob",
+    "LGBM_TPU_COST_MODEL": "observability: extra cost_analysis() compile "
+                           "feeds reporting only, never training state",
+    "LGBM_TPU_TRACE": "observability: JSONL event trace destination",
+    "LGBM_TPU_TRACE_CONTRACT": "observability: recompile accounting "
+                               "around the same programs",
+    "LGBM_TPU_MEM_CONTRACT": "observability: HBM watermark sampling",
+    "LGBM_TPU_MEM_TOL_BYTES": "watermark tolerance knob",
+    "LGBM_TPU_MEM_TOL_FRAC": "watermark tolerance knob",
+    "LGBM_TPU_MEM_LEAK_ELEMS": "fault-injection sink sizing (tests)",
+    "LGBM_TPU_DETERMINISM": "observability: the determinism contract "
+                            "itself (digest sampling + RNG ledger)",
+    "LGBM_TPU_FLIGHT_RECORDER": "observability: collective fingerprint "
+                                "ring; never alters the schedule",
+    "LGBM_TPU_FR_CAP": "flight-recorder ring size",
+    "LGBM_TPU_FAULTS": "fault-injection arming (chaos runs)",
+    "LGBM_TPU_SYNC_FREQ": "host stop-check cadence: changes when the "
+                          "host LOOKS, not what the device computes",
+    "LGBM_TPU_BLOCK_CAP": "watchdog bound on iterations per dispatch; "
+                          "block length is byte-identical by "
+                          "construction (tests/test_mesh_block.py)",
+    "LGBM_TPU_COMPACT_SLOTS": "compact-backend wave threshold: backend "
+                              "selection parity is pinned by "
+                              "tests/test_compact.py",
+    "LGBM_TPU_ROW_TILE": "kernel tiling knob; oracle parity in "
+                         "tests/test_compact.py covers all tilings",
+    "LGBM_TPU_SPLIT_VMEM_MB": "VMEM chunking budget; chunked==unchunked "
+                              "bitwise in tests/test_split_cache.py",
+    "LGBM_TPU_SPLIT_SCAN_MB": "VMEM chunking budget; chunked==unchunked "
+                              "bitwise in tests/test_split_cache.py",
+    "LGBM_TPU_SPLIT_CHUNK_F": "explicit chunk-width override; same "
+                              "bitwise merge contract",
+    "LGBM_TPU_RANK_CHUNK_PAIRS": "lambdarank pair-grid chunking; sums "
+                                 "are order-preserving per bucket",
+    "LGBM_TPU_PRED_TREE_CHUNK": "host predict chunking; per-tree sums "
+                                "accumulate in tree order regardless",
+    "LGBM_TPU_PRED_ROW_CHUNK": "host predict row chunking; rows are "
+                               "independent",
+    "LGBM_TPU_SERVE_ROW_CHUNK": "serve scorer row chunking; rows are "
+                                "independent",
+    "LGBM_TPU_NO_NATIVE": "parser backend (native C++ vs python); "
+                          "parse parity pinned by tests/test_native_parser.py",
+    "LGBM_TPU_COMPILE_CACHE": "persistent compile cache on/off; cached "
+                              "executables are content-addressed",
+    "LGBM_TPU_RETRY_ATTEMPTS": "retry policy knob",
+    "LGBM_TPU_RETRY_BASE_S": "retry policy knob",
+    "LGBM_TPU_RETRY_MAX_S": "retry policy knob",
+    "LGBM_TPU_RETRY_DEADLINE_S": "retry policy knob",
+    "LGBM_TPU_RETRY_JITTER": "retry backoff jitter; never reaches model "
+                             "state",
+}
+
+# -- DET004: first-max tie-break contracts -------------------------------
+TIE_BREAK: Dict[str, Dict] = {
+    "lightgbm_tpu/ops/split.py": {
+        "test": "tests/test_split_cache.py",
+        "pins": "chunk merge reproduces the joint argmax first-max "
+                "winner BITWISE (PR 9); full-rescan parity"},
+    "lightgbm_tpu/ops/pallas_split.py": {
+        "test": "tests/test_pallas_split.py",
+        "pins": "packed-gain kernel argmax vs XLA-scan oracle, "
+                "first-lowest-bin tie order"},
+    "lightgbm_tpu/parallel/learners.py": {
+        "test": "tests/test_parallel.py",
+        "pins": "gathered-gain argmax and voting top_k produce "
+                "serial-identical trees on 2-shard meshes"},
+    "lightgbm_tpu/boosting/gbdt.py": {
+        "test": "tests/test_engine.py",
+        "pins": "feature-mask top_k over distinct uniforms; exactly-k "
+                "contract and block/non-block mask identity"},
+    "lightgbm_tpu/metric/metrics.py": {
+        "exempt": "multiclass-error argmax feeds a scalar metric value, "
+                  "never model structure or served output"},
+    "lightgbm_tpu/sklearn.py": {
+        "exempt": "predicted-class argmax: numpy documents first-max; a "
+                  "tie needs exactly equal f64 probabilities"},
+}
+
+
+def seam_entry(env: str) -> Optional[Dict]:
+    for entry in PROGRAM_PAIRS:
+        if entry["env"] == env:
+            return entry
+    return None
+
+
+def test_exists(test_path: str) -> bool:
+    """Registered tests resolve against the repo root (tools/ anchor),
+    so analyzing a copied package tree still sees the real suite."""
+    return os.path.exists(os.path.join(REPO_ROOT, test_path))

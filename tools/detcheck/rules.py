@@ -1,0 +1,660 @@
+"""detcheck rules DET001-DET006 — determinism & numerics hazards.
+
+tpulint guards host-sync/recompile hazards, spmdcheck guards collective
+schedules, memcheck guards device memory; detcheck guards the property
+every bit-exactness test in the suite silently assumes: training and
+serving are pure functions of (data, config, seeds).  The repo has paid
+for this piecemeal three times — the PR 4/8 near-tie flip envelopes,
+PR 11's cross-program FMA-contraction surrender, and ROADMAP item 5's
+diagnosis that DART could not go multi-process because its drop RNG was
+a stateful host ``np.random.RandomState``.
+
+| id     | hazard                                                       |
+|--------|--------------------------------------------------------------|
+| DET001 | stateful / global host RNG: an ``np.random.RandomState`` /   |
+|        | ``default_rng`` stored on an instance or module (hidden      |
+|        | state across calls), a local one drawn from more than once   |
+|        | or handed to another function (consumption ORDER becomes a   |
+|        | hidden input — replay-hostile, rank-local), or a draw from   |
+|        | the global ``np.random.*`` / ``random.*`` state.  Sanctioned |
+|        | idioms: a keyed ``jax.random.fold_in`` derivation (pure in   |
+|        | ``(seed, step)``), a fresh seeded generator consumed by ONE  |
+|        | draw, or a counter-based ``np.random.Philox`` keyed by       |
+|        | ``(seed, salt)``                                             |
+| DET002 | ``jax.random`` key reuse: one key fed to two sampling sites  |
+|        | (outside mutually exclusive branches) yields correlated —    |
+|        | identical — draws; fold_in/split a fresh subkey per site     |
+| DET003 | iteration over a ``set`` (literal, ``set()``, comprehension):|
+|        | order is unspecified and PYTHONHASHSEED-dependent for str    |
+|        | keys — poison for traced operand order, model text, or       |
+|        | collective schedules.  ``sorted(...)`` the set first         |
+| DET004 | ``argmax``/``argmin``/``top_k`` without a registered         |
+|        | first-max tie-break contract: tie order IS model structure   |
+|        | (the PR 9 bitwise chunk-merge invariant).  Register the      |
+|        | pinning test in tools/detcheck/parity_registry.py TIE_BREAK, |
+|        | or declare module-level ``TIE_BREAK_CONTRACT = "<test>"``    |
+| DET005 | an env flag gating a branch in a jit-bearing module — a      |
+|        | dual-path program seam — that names no parity gate: register |
+|        | the pinning test in parity_registry.PROGRAM_PAIRS or exempt  |
+|        | it with an argument in EXEMPT_ENV                            |
+| DET006 | time / env / datetime reads inside traced scope: the value   |
+|        | constant-folds at trace time, so two processes (or two runs) |
+|        | tracing under different clocks/environments compile          |
+|        | DIFFERENT programs that claim to be the same                 |
+
+Suppression: ``# detcheck: disable=DETxxx -- why`` (shared
+analysis_core syntax; an undocumented disable is tpulint TPL000).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis_core import FileInfo, Finding
+from tools.tpulint.callgraph import (FunctionInfo, _callee_name,
+                                     compute_traced)
+from tools.tpulint.rules import NP_ALIASES, _root_name, _walk_own
+
+from . import parity_registry
+
+RULE_TITLES = {
+    "DET001": "stateful / global host RNG on a training or serving path",
+    "DET002": "jax.random key reused across sampling sites",
+    "DET003": "iteration over an unordered set",
+    "DET004": "argmax/top_k without a registered tie-break contract",
+    "DET005": "dual-path program seam without a registered parity gate",
+    "DET006": "time/env read inside traced scope",
+}
+
+# np.random.* draws that consume the GLOBAL numpy RNG state
+_GLOBAL_NP_DRAWS = {
+    "rand", "randn", "random", "random_sample", "uniform", "normal",
+    "choice", "permutation", "shuffle", "randint", "binomial", "beta",
+    "gamma", "poisson", "exponential", "sample", "standard_normal",
+    "seed", "bytes",
+}
+# stdlib random-module draws
+_STDLIB_RANDOM_DRAWS = {
+    "random", "uniform", "randint", "randrange", "choice", "choices",
+    "shuffle", "sample", "gauss", "normalvariate", "betavariate",
+    "expovariate", "seed", "getrandbits", "triangular",
+}
+# jax.random samplers whose FIRST argument is a consumed key
+_JAX_SAMPLERS = {
+    "uniform", "normal", "bernoulli", "randint", "choice", "permutation",
+    "gumbel", "truncated_normal", "categorical", "exponential", "laplace",
+    "beta", "gamma", "poisson", "bits", "rademacher", "dirichlet",
+    "shuffle",
+}
+_KEY_DERIVERS = {"PRNGKey", "key", "fold_in", "split"}
+
+_TIME_READS = {"time", "perf_counter", "monotonic", "time_ns",
+               "process_time", "perf_counter_ns", "monotonic_ns"}
+_DATETIME_READS = {"now", "utcnow", "today"}
+
+# traced-program constructs whose presence makes a module "jit-bearing"
+# for DET005 (an env branch in such a module can select what compiles)
+_PROGRAM_MARKERS = {"jit", "pjit", "pallas_call", "shard_map", "scan",
+                    "fori_loop", "while_loop"}
+
+
+@dataclass
+class DetContext:
+    root: str
+    files: List[FileInfo]
+    by_rel: Dict[str, FileInfo]
+    functions: Dict[str, FunctionInfo]
+    traced: Set[str]
+    project_rules: bool = True
+
+
+def build_context(files: Sequence[FileInfo], root: str,
+                  project_rules: bool = True) -> DetContext:
+    functions, traced = compute_traced(files)
+    return DetContext(root=root, files=list(files),
+                      by_rel={fi.rel: fi for fi in files},
+                      functions=functions, traced=traced,
+                      project_rules=project_rules)
+
+
+# -- shared helpers -------------------------------------------------------
+def _dotted(node: ast.AST) -> Optional[str]:
+    """Full dotted name of an attribute chain: ``np.random.rand`` ->
+    "np.random.rand"; None when any link is not a Name/Attribute."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if not isinstance(node, ast.Name):
+        return None
+    parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _imports_module(fi: FileInfo, name: str) -> Set[str]:
+    """Aliases under which module ``name`` is imported ('random' ->
+    {'random'} for ``import random``, {'rnd'} for ``as rnd``)."""
+    out: Set[str] = set()
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name == name:
+                    out.add(a.asname or a.name)
+    return out
+
+
+def _enclosing_functions(fi: FileInfo):
+    """Yield every def (incl. nested) in the file."""
+    for node in ast.walk(fi.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            yield node
+
+
+# -- DET001 ---------------------------------------------------------------
+def _is_rng_ctor(call: ast.Call) -> bool:
+    name = _callee_name(call.func)
+    return name in ("RandomState", "default_rng")
+
+
+def rule_det001(fi: FileInfo, ctx: DetContext) -> List[Finding]:
+    out: List[Finding] = []
+
+    def flag(node: ast.AST, what: str, fix: str) -> None:
+        out.append(Finding(
+            fi.rel, node.lineno, "DET001",
+            f"{what}: stateful host RNG on a training/serving path is "
+            f"replay-hostile (resume/rank divergence — the DART drop-RNG "
+            f"class, ROADMAP item 5); {fix}"))
+
+    random_aliases = _imports_module(fi, "random")
+
+    # (a) global-state draws: np.random.<draw>() / random.<draw>()
+    for node in ast.walk(fi.tree):
+        if not isinstance(node, ast.Call):
+            continue
+        dotted = _dotted(node.func)
+        if dotted is None:
+            continue
+        parts = dotted.split(".")
+        if (len(parts) == 3 and parts[0] in NP_ALIASES
+                and parts[1] == "random" and parts[2] in _GLOBAL_NP_DRAWS):
+            flag(node, f"draw from the global numpy RNG ({dotted})",
+                 "derive from an explicit seed: jax.random.fold_in for "
+                 "device paths, or a fresh single-draw "
+                 "np.random.Philox/RandomState(seed) on the host")
+        elif (len(parts) == 2 and parts[0] in random_aliases
+                and parts[1] in _STDLIB_RANDOM_DRAWS):
+            flag(node, f"draw from the global stdlib RNG ({dotted})",
+                 "thread an explicit seeded generator, or justify-"
+                 "suppress when the draw can never reach model state")
+
+    # (b) RandomState/default_rng constructions: stateful if stored on
+    # self/module, sequential if a local is drawn from more than once
+    # or escapes into another call
+    for node in ast.walk(fi.tree):
+        if not (isinstance(node, ast.Assign)
+                and isinstance(node.value, ast.Call)
+                and _is_rng_ctor(node.value)):
+            continue
+        ctor = _callee_name(node.value.func)
+        stored = None
+        local = None
+        for t in node.targets:
+            if isinstance(t, ast.Attribute):     # self._rng = ...
+                stored = t
+            elif isinstance(t, ast.Name):
+                local = t.id
+        if stored is not None:
+            flag(node, f"{ctor} stored on an instance/module attribute",
+                 "replace with a pure (seed, step)-keyed "
+                 "jax.random.fold_in derivation (the bagging/feature-"
+                 "mask idiom, boosting/gbdt.py)")
+            continue
+        if local is None:
+            continue
+        # module-scope assignment = process-lifetime state
+        if node in fi.tree.body:
+            flag(node, f"{ctor} bound at module scope",
+                 "construct per call from an explicit seed")
+            continue
+        uses = _rng_uses(fi, node, local)
+        if len(uses) > 1:
+            flag(node, f"{ctor} local `{local}` consumed by "
+                 f"{len(uses)} draw sites",
+                 "sequential draw order is a hidden input: derive each "
+                 "draw from its own (seed, salt) key — hash-based "
+                 "permutation / np.random.Philox(key=[seed, salt]) — or "
+                 "collapse to one draw")
+    return out
+
+
+def _rng_uses(fi: FileInfo, assign: ast.Assign, name: str) -> List[int]:
+    """Draw/escape sites of RNG local ``name`` belonging to THIS
+    assignment: method calls ``name.x(...)`` and ``name`` passed as a
+    call argument (an escape we can't count = at least one opaque draw
+    site), bounded by the next reassignment of the same name (two
+    sibling ``rng = RandomState(...)`` branches each own their draws)."""
+    fn = _innermost_function(fi, assign)
+    scope = fn if fn is not None else fi.tree
+    next_assign = min((n.lineno for n in ast.walk(scope)
+                       if isinstance(n, ast.Assign) and n is not assign
+                       and n.lineno > assign.lineno
+                       and any(isinstance(t, ast.Name) and t.id == name
+                               for t in n.targets)),
+                      default=1 << 30)
+    uses: List[int] = []
+    for node in ast.walk(scope):
+        if isinstance(node, ast.Call):
+            in_range = assign.lineno <= node.lineno < next_assign
+            f = node.func
+            if (isinstance(f, ast.Attribute) and isinstance(f.value, ast.Name)
+                    and f.value.id == name and in_range):
+                uses.append(node.lineno)
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                if (isinstance(arg, ast.Name) and arg.id == name
+                        and in_range):
+                    uses.append(node.lineno)
+    return uses
+
+
+_FN_CACHE: Dict[str, List[Tuple[ast.AST, Set[int]]]] = {}
+
+
+def _innermost_function(fi: FileInfo, node: ast.AST) -> Optional[ast.AST]:
+    """Innermost def containing ``node`` (by identity)."""
+    best = None
+    for fn in _enclosing_functions(fi):
+        for sub in ast.walk(fn):
+            if sub is node:
+                best = fn            # later (nested) defs win: ast.walk
+                break                # yields outer defs before inner ones
+    return best
+
+
+# -- DET002 ---------------------------------------------------------------
+def _branch_path(fn: ast.AST, target: ast.AST) -> List[Tuple[int, int]]:
+    """[(id(if_node), arm)] chain of If/IfExp ancestors of ``target``
+    inside ``fn`` (arm 0 = body, 1 = orelse)."""
+    path: List[Tuple[int, int]] = []
+
+    def walk(node: ast.AST, acc: List[Tuple[int, int]]) -> bool:
+        if node is target:
+            path.extend(acc)
+            return True
+        if isinstance(node, (ast.If, ast.IfExp)):
+            body = node.body if isinstance(node.body, list) else [node.body]
+            orelse = (node.orelse if isinstance(node.orelse, list)
+                      else [node.orelse])
+            for child in ast.iter_child_nodes(node):
+                in_body = any(child is b or _contains(b, child)
+                              for b in body)
+                in_else = any(child is o or _contains(o, child)
+                              for o in orelse)
+                arm = 0 if in_body else (1 if in_else else -1)
+                nxt = acc + [(id(node), arm)] if arm >= 0 else acc
+                if walk(child, nxt):
+                    return True
+            return False
+        for child in ast.iter_child_nodes(node):
+            if walk(child, acc):
+                return True
+        return False
+
+    walk(fn, [])
+    return path
+
+
+def _contains(parent: ast.AST, node: ast.AST) -> bool:
+    return any(sub is node for sub in ast.walk(parent))
+
+
+def _exclusive(p1: List[Tuple[int, int]], p2: List[Tuple[int, int]]) -> bool:
+    d1, d2 = dict(p1), dict(p2)
+    return any(d1[k] != d2[k] for k in d1.keys() & d2.keys())
+
+
+def rule_det002(fi: FileInfo, ctx: DetContext) -> List[Finding]:
+    if "jax" not in fi.source:
+        return []
+    out: List[Finding] = []
+    for fn in _enclosing_functions(fi):
+        # key-name assignment lines (PRNGKey/fold_in/split results)
+        assigns: Dict[str, List[int]] = {}
+        for node in _walk_own(fn):
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, (ast.Call, ast.Subscript)):
+                call = node.value
+                if isinstance(call, ast.Subscript):
+                    call = call.value
+                if (isinstance(call, ast.Call)
+                        and _callee_name(call.func) in _KEY_DERIVERS):
+                    for t in node.targets:
+                        names = (t.elts if isinstance(t, (ast.Tuple,
+                                                          ast.List))
+                                 else [t])
+                        for tt in names:
+                            if isinstance(tt, ast.Name):
+                                assigns.setdefault(tt.id, []).append(
+                                    node.lineno)
+        if not assigns:
+            continue
+        # sampler consumption sites per key name
+        uses: Dict[str, List[ast.Call]] = {}
+        for node in _walk_own(fn):
+            if (isinstance(node, ast.Call)
+                    and _callee_name(node.func) in _JAX_SAMPLERS
+                    and node.args and isinstance(node.args[0], ast.Name)
+                    and node.args[0].id in assigns):
+                uses.setdefault(node.args[0].id, []).append(node)
+        for name, sites in uses.items():
+            if len(sites) < 2:
+                continue
+            sites.sort(key=lambda n: n.lineno)
+            paths = [_branch_path(fn, s) for s in sites]
+            for j in range(1, len(sites)):
+                prior = None
+                for i in range(j):
+                    refolded = any(
+                        sites[i].lineno < a <= sites[j].lineno
+                        for a in assigns[name])
+                    if not refolded and not _exclusive(paths[i], paths[j]):
+                        prior = sites[i]
+                        break
+                if prior is not None:
+                    out.append(Finding(
+                        fi.rel, sites[j].lineno, "DET002",
+                        f"key `{name}` already consumed by a sampler at "
+                        f"line {prior.lineno}: reusing a jax.random key "
+                        f"yields IDENTICAL draws, silently correlating "
+                        f"the two sites; fold_in a distinct salt per "
+                        f"site (key = jax.random.fold_in(key, site_id))"))
+    return out
+
+
+# -- DET003 ---------------------------------------------------------------
+def _is_set_literal(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return True
+    return (isinstance(node, ast.Call)
+            and _callee_name(node.func) in ("set", "frozenset"))
+
+
+def _set_assignments(scope: ast.AST
+                     ) -> Tuple[Dict[str, List[int]], Dict[str, List[int]]]:
+    """name -> sorted linenos of ``name = <set expr>`` assignments in
+    ``scope`` (one pass, so Name resolution below is a dict lookup)."""
+    out: Dict[str, List[int]] = {}
+    nonset: Dict[str, List[int]] = {}
+    for sub in _walk_own(scope):
+        if (isinstance(sub, ast.Assign) and len(sub.targets) == 1
+                and isinstance(sub.targets[0], ast.Name)):
+            dest = out if _is_set_literal(sub.value) else nonset
+            dest.setdefault(sub.targets[0].id, []).append(sub.lineno)
+    # a later non-set reassignment shadows: keep both tables
+    return {n: sorted(ls) for n, ls in out.items()}, \
+        {n: sorted(ls) for n, ls in nonset.items()}
+
+
+def _is_set_expr(node: ast.AST, tables) -> bool:
+    if _is_set_literal(node):
+        return True
+    if isinstance(node, ast.Name) and tables is not None:
+        sets, nonsets = tables
+        prior_set = max((l for l in sets.get(node.id, ())
+                         if l <= node.lineno), default=None)
+        if prior_set is None:
+            return False
+        prior_non = max((l for l in nonsets.get(node.id, ())
+                         if l <= node.lineno), default=-1)
+        return prior_set > prior_non
+    return False
+
+
+def rule_det003(fi: FileInfo, ctx: DetContext) -> List[Finding]:
+    if "set" not in fi.source:
+        return []
+    out: List[Finding] = []
+
+    def flag(node: ast.AST, how: str) -> None:
+        out.append(Finding(
+            fi.rel, node.lineno, "DET003",
+            f"{how} a set: iteration order is unspecified (and "
+            f"PYTHONHASHSEED-dependent for strings) — if it reaches "
+            f"traced operand order, model text, or a collective "
+            f"schedule, two runs diverge; iterate `sorted(...)` of it"))
+
+    for fn in list(_enclosing_functions(fi)) + [None]:
+        scope = fn if fn is not None else fi.tree
+        tables = _set_assignments(scope)
+        for node in _walk_own(scope):
+            iters: List[ast.AST] = []
+            if isinstance(node, (ast.For, ast.AsyncFor)):
+                iters.append(node.iter)
+            elif isinstance(node, (ast.ListComp, ast.GeneratorExp,
+                                   ast.DictComp, ast.SetComp)):
+                iters.extend(g.iter for g in node.generators)
+            elif (isinstance(node, ast.Call)
+                  and _callee_name(node.func) in ("list", "tuple",
+                                                  "enumerate", "reversed")
+                  and node.args):
+                iters.append(node.args[0])
+            for it in iters:
+                if _is_set_expr(it, tables):
+                    flag(node, "iterating")
+    return out
+
+
+# -- DET004 ---------------------------------------------------------------
+_ORDER_SENSITIVE = {"argmax", "argmin", "top_k"}
+
+
+def _declares_tie_break(fi: FileInfo) -> bool:
+    for node in fi.tree.body:
+        if (isinstance(node, ast.Assign) and len(node.targets) == 1
+                and isinstance(node.targets[0], ast.Name)
+                and node.targets[0].id == "TIE_BREAK_CONTRACT"
+                and isinstance(node.value, ast.Constant)
+                and isinstance(node.value.value, str)):
+            return True
+    return False
+
+
+def rule_det004(fi: FileInfo, ctx: DetContext) -> List[Finding]:
+    calls = [n for n in ast.walk(fi.tree)
+             if isinstance(n, ast.Call)
+             and _callee_name(n.func) in _ORDER_SENSITIVE]
+    if not calls:
+        return []
+    entry = parity_registry.TIE_BREAK.get(fi.rel)
+    if entry is not None:
+        if "exempt" in entry:
+            return []
+        test = entry.get("test", "")
+        if parity_registry.test_exists(test):
+            return []
+        return [Finding(
+            fi.rel, calls[0].lineno, "DET004",
+            f"tie-break contract registered but its pinning test "
+            f"`{test}` does not exist: the gate rotted — restore the "
+            f"test or re-register")]
+    if _declares_tie_break(fi):
+        return []
+    return [Finding(
+        fi.rel, c.lineno, "DET004",
+        f"`{_callee_name(c.func)}` selects among candidates with no "
+        f"registered first-max tie-break contract: tie order IS model "
+        f"structure / served output (the PR 9 bitwise chunk-merge "
+        f"invariant); register the pinning test in tools/detcheck/"
+        f"parity_registry.py TIE_BREAK or declare TIE_BREAK_CONTRACT "
+        f"at module scope") for c in calls]
+
+
+# -- DET005 ---------------------------------------------------------------
+def _env_read_name(node: ast.Call) -> Optional[str]:
+    """Constant env-var name of environ.get(...)/getenv(...) calls."""
+    f = node.func
+    name = None
+    if isinstance(f, ast.Attribute) and f.attr in ("get", "getenv"):
+        base = _dotted(f.value) or ""
+        if f.attr == "get" and not base.endswith("environ"):
+            return None
+        name = node.args[0] if node.args else None
+    elif isinstance(f, ast.Name) and f.id == "getenv":
+        name = node.args[0] if node.args else None
+    if (isinstance(name, ast.Constant) and isinstance(name.value, str)):
+        return name.value
+    return None
+
+
+def _module_has_program_markers(fi: FileInfo) -> bool:
+    for node in ast.walk(fi.tree):
+        if isinstance(node, ast.Call) \
+                and _callee_name(node.func) in _PROGRAM_MARKERS:
+            return True
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in node.decorator_list:
+                if _callee_name(dec) in _PROGRAM_MARKERS:
+                    return True
+    return False
+
+
+def rule_det005(fi: FileInfo, ctx: DetContext) -> List[Finding]:
+    if "environ" not in fi.source and "getenv" not in fi.source:
+        return []
+    if not _module_has_program_markers(fi):
+        return []
+    # env reads that CONTROL a branch: inside an If/IfExp/While test,
+    # or inside a Compare / membership expression anywhere (the
+    # `environ.get("X", "1") != "0"` seam-predicate idiom — callers
+    # branch on the returned bool)
+    test_spans: List[ast.AST] = []
+    for node in ast.walk(fi.tree):
+        if isinstance(node, (ast.If, ast.IfExp, ast.While)):
+            test_spans.append(node.test)
+        elif isinstance(node, ast.Compare):
+            test_spans.append(node)
+    out: List[Finding] = []
+    seen: Set[Tuple[int, str]] = set()
+    for span in test_spans:
+        for node in ast.walk(span):
+            if not isinstance(node, ast.Call):
+                continue
+            env = _env_read_name(node)
+            if env is None or not env.startswith("LGBM_"):
+                continue
+            if (node.lineno, env) in seen:
+                continue
+            seen.add((node.lineno, env))
+            entry = parity_registry.seam_entry(env)
+            if entry is not None:
+                if parity_registry.test_exists(entry["test"]):
+                    continue
+                out.append(Finding(
+                    fi.rel, node.lineno, "DET005",
+                    f"program seam `{env}` is registered but its parity "
+                    f"gate `{entry['test']}` does not exist: restore the "
+                    f"test or re-register"))
+            elif env not in parity_registry.EXEMPT_ENV:
+                out.append(Finding(
+                    fi.rel, node.lineno, "DET005",
+                    f"env flag `{env}` gates a branch in a jit-bearing "
+                    f"module — a dual-path program seam with NO "
+                    f"registered parity gate (the PR 11 lesson: two "
+                    f"programs are only byte-identical when a test pins "
+                    f"them); add a PROGRAM_PAIRS entry mapping it to "
+                    f"its pinning test in tools/detcheck/"
+                    f"parity_registry.py, or EXEMPT_ENV it with an "
+                    f"argument"))
+    return out
+
+
+# -- DET006 ---------------------------------------------------------------
+def _env_contract_covered(env: Optional[str]) -> bool:
+    """Env names already under the DET005 parity contract (a registered
+    seam or an exempted knob) are DECLARED trace-time inputs — their
+    cross-program story is pinned elsewhere, so DET006 stays quiet."""
+    if env is None:
+        return False
+    return (parity_registry.seam_entry(env) is not None
+            or env in parity_registry.EXEMPT_ENV)
+
+
+def rule_det006(fi: FileInfo, ctx: DetContext) -> List[Finding]:
+    out: List[Finding] = []
+    traced_here = [info for q, info in ctx.functions.items()
+                   if q in ctx.traced and info.fi.rel == fi.rel]
+
+    def flag(node: ast.AST, what: str) -> None:
+        out.append(Finding(
+            fi.rel, node.lineno, "DET006",
+            f"{what} inside traced scope: the value constant-folds at "
+            f"TRACE time, so two processes (or a retrace) compile "
+            f"different programs that claim to be the same computation; "
+            f"read it on the host and pass the value in as an operand "
+            f"or static arg (or register the knob as a seam in "
+            f"tools/detcheck/parity_registry.py)"))
+
+    time_aliases = _imports_module(fi, "time") | {"time"}
+    for info in traced_here:
+        for node in _walk_own(info.node):
+            if isinstance(node, ast.Call):
+                dotted = _dotted(node.func) or ""
+                parts = dotted.split(".")
+                if (len(parts) == 2 and parts[0] in time_aliases
+                        and parts[1] in _TIME_READS):
+                    flag(node, f"{dotted}() clock read")
+                elif (len(parts) >= 2 and parts[-1] in _DATETIME_READS
+                      and "datetime" in parts[:-1]):
+                    flag(node, f"{dotted}() clock read")
+                elif ((_dotted(node.func) or "").endswith((
+                        "environ.get", "os.getenv"))
+                        or isinstance(node.func, ast.Name)
+                        and node.func.id == "getenv"):
+                    env = _env_read_name(node)
+                    if not _env_contract_covered(env):
+                        flag(node, f"environment read (`{env or '?'}`)")
+            elif (isinstance(node, ast.Subscript)
+                  and (_dotted(node.value) or "").endswith("environ")
+                  and not (isinstance(node.slice, ast.Constant)
+                           and _env_contract_covered(node.slice.value))):
+                flag(node, "os.environ[...] read")
+    return out
+
+
+FILE_RULES: List[Callable[[FileInfo, DetContext], List[Finding]]] = [
+    rule_det001, rule_det002, rule_det003, rule_det004, rule_det005,
+    rule_det006,
+]
+
+
+# -- project rule: the registry itself must be sound ----------------------
+def rule_registry_sound(ctx: DetContext) -> List[Finding]:
+    """Every registered parity gate / tie-break test must exist, and no
+    env is both a PROGRAM_PAIRS seam and EXEMPT (ambiguous contract)."""
+    reg_rel = "tools/detcheck/parity_registry.py"
+    out: List[Finding] = []
+    seam_envs = set()
+    for entry in parity_registry.PROGRAM_PAIRS:
+        seam_envs.add(entry["env"])
+        if not parity_registry.test_exists(entry["test"]):
+            out.append(Finding(
+                reg_rel, 1, "DET005",
+                f"PROGRAM_PAIRS entry `{entry['name']}` names missing "
+                f"test `{entry['test']}`"))
+    for env in seam_envs & set(parity_registry.EXEMPT_ENV):
+        out.append(Finding(
+            reg_rel, 1, "DET005",
+            f"`{env}` is both a registered seam and exempt: pick one"))
+    for rel, entry in parity_registry.TIE_BREAK.items():
+        if "exempt" not in entry and not parity_registry.test_exists(
+                entry.get("test", "")):
+            out.append(Finding(
+                reg_rel, 1, "DET004",
+                f"TIE_BREAK entry for `{rel}` names missing test "
+                f"`{entry.get('test')}`"))
+    return out
+
+
+PROJECT_RULES = [rule_registry_sound]

@@ -1,0 +1,203 @@
+"""Train-twice replay harness: ``python -m tools.replay_check``.
+
+The executable form of the reproducibility contract
+(``obs/determinism.py``, ``LGBM_TPU_DETERMINISM=1``): every scenario
+trains the SAME toy workload twice from identical seeds and asserts
+the windowed model/score digest ledgers are IDENTICAL — serial,
+bagged+feature-fraction, 2-shard data-parallel mesh, the keyed-RNG
+DART, and GOSS.  A mismatch exits nonzero naming the FIRST diverging
+window, which is the localization a real determinism bug needs (the
+window bounds which iterations introduced it).
+
+``--drift-proof`` additionally proves the wall trips: a DART run with
+the ``det.rng_drift`` fault armed (``utils/faults.py`` — the keyed
+drop derivation silently consumes the next iteration's draws) must
+diverge from the clean ledger, and the harness must name the first
+diverging window at or after the armed iteration.
+
+Scenario ``mesh2`` needs two devices; on a single-device host the
+harness re-execs itself in a child with a 2-device virtual CPU pool
+(the bench ``--multichip-child`` pattern).
+
+Usage::
+
+    python -m tools.replay_check [--scenarios serial,bagged,mesh2,dart,goss]
+                                 [--rows 600] [--rounds 8] [--drift-proof]
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+from typing import Dict, List, Optional, Tuple
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ.setdefault("LGBM_TPU_DETERMINISM", "1")
+
+import numpy as np
+
+SCENARIOS = ("serial", "bagged", "mesh2", "dart", "goss")
+
+BASE_PARAMS = {"objective": "binary", "metric": "auc", "num_leaves": 7,
+               "min_data_in_leaf": 5, "verbose": -1, "output_freq": 2,
+               "learning_rate": 0.2}
+
+SCENARIO_PARAMS: Dict[str, Dict] = {
+    "serial": {},
+    "bagged": {"bagging_fraction": 0.7, "bagging_freq": 1,
+               "feature_fraction": 0.8},
+    "mesh2": {"tree_learner": "data", "mesh_shape": [2]},
+    "dart": {"boosting": "dart", "drop_rate": 0.5, "drop_seed": 4},
+    "goss": {"boosting": "goss", "top_rate": 0.3, "other_rate": 0.2},
+}
+
+
+def _toy_data(rows: int, f: int = 6, seed: int = 7):
+    """Synthetic binary data, pure in ``seed`` (counter-based Philox —
+    the harness itself must satisfy its own contract)."""
+    gen = np.random.Generator(np.random.Philox(key=[seed, 0]))
+    X = gen.normal(size=(rows, f)).astype(np.float32)
+    noise = np.random.Generator(np.random.Philox(key=[seed, 1])).normal(
+        size=rows)
+    y = (X[:, 0] + 0.5 * noise > 0).astype(np.float64)
+    nv = max(64, rows // 4)
+    Xv = np.random.Generator(np.random.Philox(key=[seed, 2])).normal(
+        size=(nv, f)).astype(np.float32)
+    vnoise = np.random.Generator(np.random.Philox(key=[seed, 3])).normal(
+        size=nv)
+    yv = (Xv[:, 0] + 0.5 * vnoise > 0).astype(np.float64)
+    return X, y, Xv, yv
+
+
+def run_once(scenario: str, rows: int, rounds: int,
+             drift_at: Optional[int] = None
+             ) -> Tuple[List, str, Dict]:
+    """One training; -> (digest ledger [[it, digest], ...], final model
+    digest, rng-ledger site counters)."""
+    os.environ["LGBM_TPU_DETERMINISM"] = "1"
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import determinism
+    from lightgbm_tpu.utils import faults
+    X, y, Xv, yv = _toy_data(rows)
+    params = {**BASE_PARAMS, **SCENARIO_PARAMS[scenario]}
+    if drift_at is not None:
+        faults.inject("det.rng_drift", times=1, skip=drift_at)
+    try:
+        tr = lgb.Dataset(X, label=y)
+        bst = lgb.train(params, tr, num_boost_round=rounds,
+                        valid_sets=[lgb.Dataset(Xv, label=yv,
+                                                reference=tr)],
+                        verbose_eval=False)
+    finally:
+        if drift_at is not None:
+            faults.clear("det.rng_drift")
+    sec = determinism.section()
+    return sec["digests"], bst.digest(include_scores=False), sec["sites"]
+
+
+def check_scenario(scenario: str, rows: int, rounds: int) -> Tuple[bool, str]:
+    from lightgbm_tpu.obs import determinism
+    a_digests, a_final, a_sites = run_once(scenario, rows, rounds)
+    b_digests, b_final, b_sites = run_once(scenario, rows, rounds)
+    div = determinism.first_divergence(a_digests, b_digests)
+    if div is not None:
+        it, da, db = div
+        return False, (f"{scenario}: FAIL — first diverging window "
+                       f"it={it} ({da[:12]} vs {db[:12]})")
+    if a_final != b_final:
+        return False, (f"{scenario}: FAIL — final model digest differs "
+                       f"({a_final[:12]} vs {b_final[:12]})")
+    if a_sites != b_sites:
+        return False, (f"{scenario}: FAIL — RNG-ledger traffic differs "
+                       f"({a_sites} vs {b_sites})")
+    return True, (f"{scenario}: OK ({len(a_digests)} windows, "
+                  f"model {a_final[:12]})")
+
+
+def drift_proof(rows: int, rounds: int, drift_at: int = 3
+                ) -> Tuple[bool, str]:
+    """The wall must TRIP: an injected RNG drift in DART's keyed drop
+    derivation has to diverge the ledger, first window named."""
+    from lightgbm_tpu.obs import determinism
+    clean, _, _ = run_once("dart", rows, rounds)
+    drifted, _, _ = run_once("dart", rows, rounds, drift_at=drift_at)
+    div = determinism.first_divergence(clean, drifted)
+    if div is None:
+        return False, ("drift-proof: FAIL — det.rng_drift armed at "
+                       f"iteration {drift_at} but the digest ledgers "
+                       "are identical: the contract is blind")
+    it, da, db = div
+    return True, (f"drift-proof: OK — injected drift at iteration "
+                  f"{drift_at} localized to window it={it} "
+                  f"({da[:12]} vs {db[:12]})")
+
+
+def _mesh2_child(rows: int, rounds: int) -> Tuple[bool, str]:
+    """Re-exec for the 2-shard scenario when this process has one
+    device (XLA device count is fixed at jax init)."""
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = (env.get("XLA_FLAGS", "")
+                        + " --xla_force_host_platform_device_count=2"
+                        ).strip()
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.replay_check", "--scenarios",
+         "mesh2", "--rows", str(rows), "--rounds", str(rounds)],
+        env=env, capture_output=True, text=True,
+        cwd=os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+    lines = [ln for ln in proc.stdout.splitlines()
+             if ln.startswith("mesh2:")]
+    tail = lines[-1] if lines else "mesh2: no output from child"
+    return proc.returncode == 0, tail
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.replay_check",
+        description="train-twice determinism replay harness (the "
+                    "runtime half of detcheck)")
+    parser.add_argument("--scenarios", default=",".join(SCENARIOS))
+    parser.add_argument("--rows", type=int, default=600)
+    parser.add_argument("--rounds", type=int, default=8)
+    parser.add_argument("--drift-proof", action="store_true",
+                        help="also prove det.rng_drift trips the wall")
+    parser.add_argument("--json", action="store_true",
+                        help="emit one machine-readable JSON line")
+    args = parser.parse_args(argv)
+
+    wanted = [s.strip() for s in args.scenarios.split(",") if s.strip()]
+    bad = [s for s in wanted if s not in SCENARIOS]
+    if bad:
+        print(f"replay_check: unknown scenario(s) {bad}", file=sys.stderr)
+        return 2
+
+    import jax
+    results: List[Tuple[str, bool, str]] = []
+    for s in wanted:
+        if s == "mesh2" and len(jax.devices()) < 2:
+            ok, msg = _mesh2_child(args.rows, args.rounds)
+        else:
+            ok, msg = check_scenario(s, args.rows, args.rounds)
+        results.append((s, ok, msg))
+        print(msg)
+    if args.drift_proof:
+        ok, msg = drift_proof(args.rows, args.rounds)
+        results.append(("drift-proof", ok, msg))
+        print(msg)
+
+    failed = [s for s, ok, _ in results if not ok]
+    if args.json:
+        print(json.dumps({"replay_check_ok": not failed,
+                          "scenarios": {s: ok for s, ok, _ in results}}))
+    if failed:
+        print(f"replay_check: FAIL ({', '.join(failed)})")
+        return 1
+    print(f"replay_check: ok ({len(results)} scenario(s) digest-"
+          f"identical twice)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
