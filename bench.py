@@ -787,6 +787,71 @@ def serve_leg(dryrun: bool = False):
     }
 
 
+# keys the serve_load (QPS-sweep) leg must emit — `--dryrun` validates
+# this schema at toy shape as the tier-1 gate (tests/test_bench_budget)
+SERVE_LOAD_SCHEMA_KEYS = (
+    "serve_load_table", "serve_load_duration_s", "serve_load_qps_sweep",
+    "serve_load_rows_per_request")
+
+
+def serve_load_leg(line=None, dryrun: bool = False):
+    """Open-loop Poisson QPS sweep against a LIVE ``PredictionServer``
+    (ROADMAP item 3c's measurement instrument, ``tools/load_harness``):
+    per offered-QPS step, achieved QPS, rows/s, and p50/p99/p99.9
+    request latency — arrival times drawn up-front so the generator
+    never self-throttles when the server slows down (tail latency
+    under OFFERED load is the contract; a closed loop measures the
+    flattering one).  Steps are emitted incrementally onto ``line``
+    so a driver deadline keeps every step that ran."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import PredictionServer, compile_model
+    from tools.load_harness import sweep
+
+    f = 5 if dryrun else 28
+    n_train = int(os.environ.get("BENCH_SERVE_TRAIN_ROWS",
+                                 2_000 if dryrun else 200_000))
+    iters = int(os.environ.get("BENCH_SERVE_ITERS", 4 if dryrun else 100))
+    leaves = 7 if dryrun else 63
+    rng = np.random.RandomState(17)
+    X = rng.normal(size=(n_train, f)).astype(np.float32)
+    y = (X[:, 0] * 2 + X[:, 1] - X[:, 2]
+         + rng.normal(scale=1.0, size=n_train) > 0).astype(np.float32)
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "learning_rate": 0.1, "min_data_in_leaf": 20, "verbose": -1}
+    ds = lgb.Dataset(X, label=y, params=params)
+    bst = lgb.train(params, ds, num_boost_round=iters, verbose_eval=False)
+    del ds
+    cm = compile_model(bst)
+    pool = rng.normal(size=(8_192, f)).astype(np.float32)
+    qps_env = os.environ.get("BENCH_SERVE_LOAD_QPS", "")
+    qps = ([float(q) for q in qps_env.split(",") if q.strip()]
+           or ([150.0, 600.0] if dryrun
+               else [1_000.0, 5_000.0, 20_000.0, 50_000.0]))
+    dur = float(os.environ.get("BENCH_SERVE_LOAD_S",
+                               "0.5" if dryrun else "5"))
+    k = int(os.environ.get("BENCH_SERVE_LOAD_ROWS", 1))
+    buckets = (64, 256, 1024) if dryrun else (256, 1024, 4096)
+    out = {"serve_load_qps_sweep": qps, "serve_load_duration_s": dur,
+           "serve_load_rows_per_request": k, "serve_load_table": []}
+
+    def _step(row):
+        out["serve_load_table"].append(row)
+        if line is not None:
+            line["serve_load_table"] = out["serve_load_table"]
+            line["partial"] = f"serve-load-{row['offered_qps']:g}qps"
+            _emit(line)
+
+    srv = PredictionServer(cm, max_batch=max(buckets), max_wait_ms=1.0,
+                           buckets=buckets, min_bucket=buckets[0],
+                           raw_score=True)
+    try:
+        sweep(srv, pool, qps, dur, rows_per_request=k, seed=13,
+              emit=_step)
+    finally:
+        srv.close()
+    return out
+
+
 # extra wave-table shapes: the reference's own headline configs where
 # the last capture still loses (ROADMAP item 2) — recorded so the
 # losing regime (255-leaf split-find/routing vs histogram vs lambdarank
@@ -1148,6 +1213,22 @@ def _validate_north_star_aux(ns: dict):
                            and "ns_per_doc" in rg else
                            ("pending-capture" if good else "invalid"))
     ok = ok and good
+    # serve_load (ISSUE 13): measured rows carry offered/achieved QPS +
+    # tail columns, or an explicit pending-capture spec with the sweep
+    sl = ns.get("serve_load")
+    if isinstance(sl, list):
+        good = bool(sl) and all(
+            float(r.get("offered_qps", 0)) > 0
+            and float(r.get("achieved_qps", 0)) > 0
+            and float(r.get("p99_ms", 0)) > 0 for r in sl)
+    elif isinstance(sl, dict):
+        good = (sl.get("status") == "pending-capture"
+                and bool(sl.get("qps_sweep")))
+    else:
+        good = False
+    detail["serve_load"] = "measured" if isinstance(sl, list) else (
+        "pending-capture" if good else "invalid")
+    ok = ok and good
     # device_attribution (ISSUE 10): every future capture is expected
     # to carry attribution columns — a measured fractions dict or an
     # explicit pending-capture spec
@@ -1270,6 +1351,28 @@ def dryrun_main():
     except Exception as exc:        # noqa: BLE001 - reported on the line
         line["serve_schema_ok"] = False
         line["serve_leg"] = f"failed: {type(exc).__name__}: {exc}"
+    # serve_load leg schema gate (ISSUE 13): the REAL open-loop sweep
+    # at toy shape/duration — every row carries offered vs achieved
+    # QPS and the p50/p99/p99.9 tail columns the TPU artifact will
+    # record (tools/load_harness.py mechanics, tier-1 via
+    # tests/test_bench_budget)
+    try:
+        sl = serve_load_leg(dryrun=True)
+        missing = [k for k in SERVE_LOAD_SCHEMA_KEYS if k not in sl]
+        rows = sl.get("serve_load_table") or []
+        sane = (not missing and rows and len(rows) == len(
+            sl["serve_load_qps_sweep"]) and all(
+            r["offered_qps"] > 0 and r["achieved_qps"] > 0
+            and r["requests"] > 0 and r["failures"] == 0
+            and r["p999_ms"] >= r["p99_ms"] >= r["p50_ms"] >= 0.0
+            for r in rows))
+        line.update(sl)
+        line["serve_load_ok"] = bool(sane)
+        if missing:
+            line["serve_load_schema_missing"] = missing
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["serve_load_ok"] = False
+        line["serve_load_leg"] = f"failed: {type(exc).__name__}: {exc}"
     # device-time attribution gate (ISSUE 10): the REAL leg at toy
     # shape on CPU — windowed capture, parse, schema — with the
     # acceptance floor: >=90% of captured device time attributes to
@@ -1718,6 +1821,15 @@ def main():
             if not (sleg["serve_parity_ok"] and sleg["serve_recompile_ok"]):
                 auc_ok = False
         _checkpoint("aux-serve")
+
+    # serve_load (ISSUE 13): open-loop Poisson QPS sweep — p50/p99/
+    # p99.9 vs OFFERED load through the live server, each step emitted
+    # incrementally as it lands (tools/load_harness.py)
+    if os.environ.get("BENCH_SERVE_LOAD", "1") != "0":
+        slleg = _leg(line, "serve_load", lambda: serve_load_leg(line))
+        if slleg is not None:
+            line.update(slleg)
+        _checkpoint("aux-serve-load")
 
     if os.environ.get("BENCH_RANK", "1") != "0":
         import gc

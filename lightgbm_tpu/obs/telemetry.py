@@ -53,7 +53,7 @@ from typing import Any, Dict, IO, Optional
 __all__ = [
     "enabled", "enable", "disable", "reset", "span", "counter_add",
     "gauge_set", "event", "summary", "merged_summary", "write_summary",
-    "trace_path", "set_section", "set_annotator",
+    "trace_path", "set_section", "set_annotator", "set_sink",
 ]
 
 _lock = threading.RLock()
@@ -79,6 +79,12 @@ _sections: Dict[str, Any] = {}
 # the same name, so XLA ops attribute to the span tree.  None (the
 # default) costs one module-attribute read per span
 _annotator = None
+# live-metrics sink (obs/ops_plane.py MetricsRegistry): while the ops
+# plane is mounted, every counter/gauge/event update and span close is
+# mirrored into the scrapeable registry.  None (the default) costs one
+# module-attribute read on the already-enabled path; the disabled path
+# never reaches it — the PR 2 no-op envelope is untouched
+_sink = None
 
 
 def set_annotator(fn) -> None:
@@ -86,6 +92,14 @@ def set_annotator(fn) -> None:
     context manager).  Owned by ``obs/profiler.py``."""
     global _annotator
     _annotator = fn
+
+
+def set_sink(sink) -> None:
+    """Install/remove the live-metrics sink (counter/gauge/event/span
+    callbacks).  Owned by ``obs/ops_plane.py``; survives :func:`reset`
+    — the plane's lifecycle is the process, not one run."""
+    global _sink
+    _sink = sink
 
 
 def _rank_world():
@@ -160,6 +174,8 @@ def reset() -> None:
     flight_recorder.reset()
     from . import profiler
     profiler.reset()
+    from . import health
+    health.reset()
 
 
 def trace_path() -> Optional[str]:
@@ -314,6 +330,9 @@ class _Span:
             agg[1] += dur
             if dur > agg[2]:
                 agg[2] = dur
+            sink = _sink
+            if sink is not None:
+                sink.span(self.name, dur)
             if _trace_requested:
                 rec = {"ts": self.ts, "kind": "span", "name": self.name,
                        "rank": rank, "dur_s": dur, "depth": self.depth,
@@ -342,6 +361,9 @@ def counter_add(name: str, n: float = 1) -> None:
     rank, _ = _rank_world()
     with _lock:
         _counters[name] = _counters.get(name, 0) + n
+        sink = _sink
+        if sink is not None:
+            sink.counter(name, n, _counters[name])
         if _trace_requested:
             _trace_write({"ts": time.time(), "kind": "counter",
                           "name": name, "rank": rank, "add": n,
@@ -354,6 +376,9 @@ def gauge_set(name: str, value: Any) -> None:
     rank, _ = _rank_world()
     with _lock:
         _gauges[name] = value
+        sink = _sink
+        if sink is not None:
+            sink.gauge(name, value)
         if _trace_requested:
             _trace_write({"ts": time.time(), "kind": "gauge",
                           "name": name, "rank": rank, "value": value})
@@ -370,6 +395,9 @@ def event(kind: str, name: str, **fields) -> None:
     with _lock:
         key = f"{kind}:{name}"
         _events[key] = _events.get(key, 0) + 1
+        sink = _sink
+        if sink is not None:
+            sink.event(key, _events[key])
         if _trace_requested:
             rec = {"ts": time.time(), "kind": "event", "name": name,
                    "rank": rank, "family": kind}
@@ -445,6 +473,17 @@ def merged_summary(allgather) -> Dict[str, Any]:
     check = flight_recorder.cross_check_summaries(locals_)
     if check is not None:
         merged["flight_recorder_check"] = check
+    # per-rank health state, first-class (the ranks already carry their
+    # full `health` sections; the lift makes the fleet view one read):
+    # `worst` is what a supervisor should act on
+    hs = [(s.get("health") or {}).get("state") for s in locals_]
+    if any(hs):
+        order = ("ready", "warming", "draining", "degraded", "stalled")
+        known = [h for h in hs if h in order]
+        merged["health"] = {
+            "ranks": hs,
+            "worst": (max(known, key=order.index) if known else None),
+        }
     return merged
 
 

@@ -29,7 +29,17 @@ shutdown are scored before the worker exits.
 Telemetry: ``serve.compile`` (warmup, per bucket), ``serve.batch``
 (one per coalesced dispatch, with rows/bucket/requests attrs),
 ``serve.score`` (inside the model, one per device dispatch), counters
-``serve.requests/.rows/.batches/.padded_rows``.
+``serve.requests/.rows/.batches/.padded_rows``.  Per-bucket request
+latency accumulates into bounded ROLLING quantile sketches
+(``obs/ops_plane.RollingQuantiles``): ``stats()`` reports windowed
+p50/p99/p99.9 at constant memory under sustained traffic.
+
+Live ops plane: with ``LGBM_TPU_OPS_PORT`` set the server mounts the
+``/metrics`` + ``/healthz`` HTTP surface (``obs/ops_plane.py``) and
+wires ``/drain`` to itself — stop accepting, flush every queued
+request (exactly-once delivery holds through the drain), report final
+stats.  ``LGBM_TPU_WATCHDOG_S`` arms the stall watchdog around each
+coalesced batch dispatch (``obs/health.py``).
 """
 from __future__ import annotations
 
@@ -43,7 +53,10 @@ from typing import Dict, List, Optional, Sequence
 import numpy as np
 
 from ..obs import counter_add, event, span, set_section
+from ..obs import health as obs_health
+from ..obs import ops_plane as obs_ops
 from ..obs import profiler as obs_profiler
+from ..obs.ops_plane import RollingQuantiles
 from ..obs.trace_contract import CompileTracker, contract_enabled
 from ..utils.faults import fault_point
 from ..utils.log import log_info, log_warning
@@ -106,7 +119,11 @@ class PredictionServer:
         self._n_batches = 0
         self._n_rows = 0
         self._n_padded = 0
-        self._latency: Dict[int, List[float]] = {}
+        # per-bucket request latency: bounded ROLLING quantile sketches
+        # (obs/ops_plane.py) — the old all-time lists grew without
+        # bound under sustained traffic and froze the percentiles on
+        # ancient history; the sketch window is LGBM_TPU_OPS_SKETCH
+        self._latency: Dict[int, RollingQuantiles] = {}
         self._carry: List[_Request] = []    # worker-only: batch overflow
         # worker-only: previous batch dispatch's return time, for the
         # serve.dispatch_gap_s host-latency counter
@@ -125,10 +142,21 @@ class PredictionServer:
         from ..obs.mem_contract import maybe_watermark
         self._mem_wm = maybe_watermark("serve", "serve_mem_contract",
                                        warmup=2).__enter__()
+        # live ops plane (obs/ops_plane.py, LGBM_TPU_OPS_PORT): the
+        # /metrics + /healthz scrape surface, with /drain wired to
+        # this server (stop accepting, flush the queue, report); the
+        # stall watchdog (LGBM_TPU_WATCHDOG_S) arms around each
+        # coalesced batch dispatch
+        self._ops = obs_ops.mount("serve")
+        if self._ops is not None:
+            self._ops.register_drain(self._drain_report)
+        self._wd = obs_health.Watchdog.maybe("serve")
+        obs_health.mark_warming("serve")
         if warmup:
             self.warm()
         if self._tracker is not None:
             self._tracker.mark_steady()
+        obs_health.mark_ready()
         self._thread = threading.Thread(
             target=self._run, name="lgbm-tpu-serve", daemon=True)
         self._thread.start()
@@ -146,8 +174,12 @@ class PredictionServer:
             if self._closed:
                 return
             self._closed = True
+        obs_health.mark_draining(plane="serve")
         self._q.put(_SENTINEL)
         self._thread.join(timeout)
+        if self._wd is not None:
+            self._wd.stop()
+            self._wd = None
         if self._tracker is not None:
             self._tracker.__exit__(None, None, None)
             rep = self._tracker.report()
@@ -172,6 +204,16 @@ class PredictionServer:
                     f"a per-batch live-buffer leak in the serving path")
         log_info(f"serve: drained ({self._n_resolved} resolved, "
                  f"{self._n_failed} failed, {self._n_batches} batches)")
+
+    def _drain_report(self) -> Dict:
+        """The ops plane's ``/drain`` hook: stop accepting, flush every
+        in-flight request (``close`` drains the queue — the
+        exactly-once delivery contract holds through the drain), and
+        report the final stats."""
+        self.close()
+        rep = self.stats()
+        rep["drained"] = True
+        return rep
 
     def __enter__(self) -> "PredictionServer":
         return self
@@ -200,9 +242,12 @@ class PredictionServer:
         return self.submit(x).result(timeout)
 
     def stats(self) -> Dict:
-        """Counts + per-bucket latency percentiles (ms)."""
+        """Counts + per-bucket latency percentiles (ms) over the
+        bounded rolling window (p50/p99/p99.9; ``count`` stays
+        all-time)."""
         with self._lock:
-            lat = {b: list(v) for b, v in self._latency.items()}
+            lat = {b: s.stats_ms() for b, s in self._latency.items()
+                   if s.count}
             out = {
                 "submitted": self._n_submitted,
                 "resolved": self._n_resolved,
@@ -213,11 +258,7 @@ class PredictionServer:
                 "pending": self._n_submitted - self._n_resolved
                            - self._n_failed,
             }
-        out["latency_ms"] = {
-            b: {"count": len(v),
-                "p50": round(float(np.percentile(v, 50)) * 1e3, 3),
-                "p99": round(float(np.percentile(v, 99)) * 1e3, 3)}
-            for b, v in lat.items() if v}
+        out["latency_ms"] = lat
         return out
 
     # -- worker ----------------------------------------------------------
@@ -282,25 +323,36 @@ class PredictionServer:
             counter_add("serve.dispatch_gap_s",
                         time.perf_counter() - t_prev)
             counter_add("serve.dispatch_gaps")
+        # stall watchdog: armed per coalesced batch — a wedged device
+        # dispatch mid-serve gets named (health:stall + forensics)
+        # while the worker is still stuck on it
+        if self._wd is not None:
+            self._wd.arm("serve.batch", batch=self._n_batches,
+                         bucket=bucket)
+            obs_health.stall_fault(self._wd)
         # step marker: while a device-time capture is live each batch
         # is a profiler step, so per-batch device cost reads directly
         # off the trace (no-op otherwise)
-        with span("serve.batch") as s, \
-                obs_profiler.step("serve.batch", self._n_batches):
-            s["rows"] = n
-            s["bucket"] = bucket
-            s["requests"] = len(batch)
-            try:
-                out = retry_call(self._score, X, policy=self._retry,
-                                 what="serve.score")
-            except Exception as exc:    # noqa: BLE001 - resolved into futures
-                log_warning(f"serve: batch of {len(batch)} request(s) "
-                            f"failed after retries: {exc}")
-                with self._lock:
-                    self._n_failed += len(batch)
-                for r in batch:
-                    r.future.set_exception(exc)
-                return
+        try:
+            with span("serve.batch") as s, \
+                    obs_profiler.step("serve.batch", self._n_batches):
+                s["rows"] = n
+                s["bucket"] = bucket
+                s["requests"] = len(batch)
+                try:
+                    out = retry_call(self._score, X, policy=self._retry,
+                                     what="serve.score")
+                except Exception as exc:  # noqa: BLE001 - into futures
+                    log_warning(f"serve: batch of {len(batch)} "
+                                f"request(s) failed after retries: {exc}")
+                    with self._lock:
+                        self._n_failed += len(batch)
+                    for r in batch:
+                        r.future.set_exception(exc)
+                    return
+        finally:
+            if self._wd is not None:
+                self._wd.disarm()
         out = np.asarray(out)[:n]
         now = time.perf_counter()
         self._t_last_dispatch = now
@@ -308,7 +360,7 @@ class PredictionServer:
             self._n_batches += 1
             self._n_rows += n
             self._n_padded += bucket - n
-            lat = self._latency.setdefault(bucket, [])
+            lat = self._latency.setdefault(bucket, RollingQuantiles())
         counter_add("serve.batches")
         counter_add("serve.rows_batched", n)
         counter_add("serve.padded_rows", bucket - n)
@@ -323,8 +375,7 @@ class PredictionServer:
             off += k
             with self._lock:
                 self._n_resolved += 1
-                if len(lat) < 100_000:
-                    lat.append(now - r.t_enqueue)
+                lat.observe(now - r.t_enqueue)
             # exactly-once: a Future can only be resolved once — a
             # retry re-scores the batch but delivery happens here, once
             r.future.set_result(res[0] if k == 1 else res)

@@ -32,7 +32,18 @@ the seams where production faults actually strike:
   draws instead of its own — simulating the RNG-divergence class
   (mis-keyed fold_in, stale seed plumbing) the determinism contract
   (``obs/determinism.py``, ``LGBM_TPU_DETERMINISM=1``) must catch by
-  naming the first diverging eval window.
+  naming the first diverging eval window,
+* ``watchdog.stall`` — a SILENT fault (``fault_flag``): while armed,
+  the training window / serve batch currently armed on the stall
+  watchdog (``obs/health.py``, ``LGBM_TPU_WATCHDOG_S``) sleeps
+  in-window past the deadline — simulating the hung-dispatch class
+  (wedged collective, dead tunnel) the watchdog must name in a
+  ``health:stall`` event + kill-survivable forensic dump,
+* ``health.nan_grad`` — a SILENT fault: while armed, one gradient
+  element is poisoned to NaN (``boosting/gbdt._gradients``) —
+  simulating the numerics-divergence class the window-boundary
+  sentinels (``obs/health.py``) must catch with a ``health:nonfinite``
+  event naming the window and a ``/healthz`` flip to ``degraded``.
 
 Each point is a single ``fault_point(name)`` call that is a no-op unless
 armed.  Tests arm points programmatically (:func:`inject`, or the
@@ -58,7 +69,7 @@ from typing import Dict, Optional
 
 POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
           "loader.read", "spmd.skip_record", "serve.score", "mem.leak",
-          "det.rng_drift")
+          "det.rng_drift", "watchdog.stall", "health.nan_grad")
 
 
 class FaultInjected(RuntimeError):

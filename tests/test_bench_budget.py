@@ -94,13 +94,13 @@ def test_deadline_skips_aux_legs_with_markers(bench_run):
     final = _parse_lines(bench_run.stdout)[-1]
     assert "partial" not in final           # the complete line
     assert final["value"] > 0               # headline retained
-    for leg in ("serve", "valid", "bin255", "rank", "rank63", "multichip",
-                "split_finder", "rank_grad", "attribution"):
+    for leg in ("serve", "serve_load", "valid", "bin255", "rank", "rank63",
+                "multichip", "split_finder", "rank_grad", "attribution"):
         assert final.get(f"{leg}_leg") == "skipped: budget", final
     assert final.get("real_data") == "skipped: budget"
     assert set(final.get("legs_skipped", [])) >= {
-        "serve", "valid", "bin255", "rank", "rank63", "multichip",
-        "split_finder", "rank_grad", "attribution"}
+        "serve", "serve_load", "valid", "bin255", "rank", "rank63",
+        "multichip", "split_finder", "rank_grad", "attribution"}
     # an explicit skip is not a failure: no legs_failed / hard-failed
     assert "legs_failed" not in final
     assert "legs_hard_failed" not in final
@@ -147,7 +147,25 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     assert out["serve_steady_recompiles"] == 0
     assert out["serve_requests"] > 0
     for rec in out["serve_latency_ms"].values():
-        assert rec["count"] > 0 and rec["p99"] >= rec["p50"] >= 0.0
+        # ISSUE 13: the rolling sketch adds the p99.9 tail column
+        assert rec["count"] > 0
+        assert rec["p999"] >= rec["p99"] >= rec["p50"] >= 0.0
+    # serve_load QPS-sweep gate (ISSUE 13): the REAL open-loop Poisson
+    # sweep ran at toy duration — offered vs achieved QPS and the
+    # p50/p99/p99.9 tail columns on every step, zero failed requests,
+    # and the north_star.json serve_load spec parses
+    assert out["serve_load_ok"] is True, out.get(
+        "serve_load_leg", out.get("serve_load_schema_missing"))
+    from bench import SERVE_LOAD_SCHEMA_KEYS
+    for key in SERVE_LOAD_SCHEMA_KEYS:
+        assert key in out, key
+    assert len(out["serve_load_table"]) == len(out["serve_load_qps_sweep"])
+    for row in out["serve_load_table"]:
+        assert row["offered_qps"] > 0 and row["achieved_qps"] > 0
+        assert row["failures"] == 0
+        assert row["p999_ms"] >= row["p99_ms"] >= row["p50_ms"] >= 0.0
+    assert out["north_star_aux_detail"]["serve_load"] in (
+        "measured", "pending-capture"), out["north_star_aux_detail"]
     # multichip mechanics gate (PR 7 + ISSUE 11): the REAL leg ran on
     # a 2-device virtual CPU pool (re-exec'd child) — schema complete,
     # overlap on/off AND fused/unfused (LGBM_TPU_MESH_BLOCK) measured,
@@ -276,6 +294,7 @@ def test_gate_bearing_hard_failure_zeroes_headline():
            "BENCH_LEAVES": "7", "BENCH_BIN": "15",
            "BENCH_FULL": "0", "BENCH_255": "0", "BENCH_RANK": "0",
            "BENCH_WAVES": "0", "BENCH_SERVE": "0",
+           "BENCH_SERVE_LOAD": "0",
            "BENCH_ATTRIBUTION": "0",   # this test gates the valid leg
            "BENCH_FORCE_FAIL": "valid"}
     env.pop("XLA_FLAGS", None)

@@ -1,0 +1,351 @@
+"""Stall watchdog + numerics sentinel tests (obs/health.py).
+
+ISSUE 13 forensics acceptance: an injected ``watchdog.stall`` fault
+produces ``<trace>.forensic.json`` — valid JSON even after SIGKILL
+mid-dump (tmp+rename proven) — naming the stalled span and carrying
+the flight-recorder ring; an injected ``health.nan_grad`` flips
+``/healthz`` to degraded and emits ``health:nonfinite`` with the
+window index.  All on CPU in tier-1.
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import health
+from lightgbm_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.reset()
+    faults.clear()
+    yield
+    faults.clear()
+    health._set_active(False)
+    obs.reset()
+
+
+def _small_data(n=500, f=5, seed=0):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    return X, y
+
+
+# ---------------------------------------------------------------------------
+# state machine
+# ---------------------------------------------------------------------------
+def test_state_machine_transitions_and_stickiness():
+    health._set_active(True)
+    health.mark_warming("train")
+    assert health.state()["state"] == "warming"
+    health.mark_ready()
+    assert health.state()["state"] == "ready"
+    health.mark_degraded("nonfinite", window=3)
+    assert health.state()["state"] == "degraded"
+    # sticky: ready must not paper over the incident
+    health.mark_ready()
+    assert health.state()["state"] == "degraded"
+    assert health.state()["detail"]["window"] == 3
+    # escalation is allowed
+    health.mark_stalled("gbdt.block")
+    assert health.state()["state"] == "stalled"
+    health.reset()
+    assert health.state()["state"] == "warming"
+
+
+def test_inactive_marks_are_noops():
+    assert not health.tracking()
+    health.mark_warming("train")
+    health.mark_degraded("x")
+    assert health.state()["state"] == "disabled"
+    assert "health" not in obs.summary()
+
+
+# ---------------------------------------------------------------------------
+# watchdog
+# ---------------------------------------------------------------------------
+def test_watchdog_fires_names_span_and_dumps(tmp_path, monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_FORENSIC",
+                       str(tmp_path / "forensic.json"))
+    obs.enable()
+    wd = health.Watchdog("train", 0.1)
+    try:
+        wd.arm("unit.test.span", it=7)
+        assert wd.fired.wait(10.0)
+    finally:
+        wd.stop()
+    s = obs.summary()
+    assert s["events"]["health:stall"] == 1
+    assert s["counters"]["watchdog.arms"] == 1
+    assert s["counters"]["watchdog.fires"] == 1
+    assert health.state()["state"] == "stalled"
+    assert health.state()["detail"]["stalled_span"] == "unit.test.span"
+    dump = json.load(open(tmp_path / "forensic.json"))
+    assert dump["span"] == "unit.test.span"
+    assert dump["attrs"] == {"it": 7}
+    assert dump["plane"] == "train"
+    assert "flight_recorder" in dump
+    # the all-thread stack dump names this very test frame
+    assert "MainThread" in dump["stacks"] or "Thread" in dump["stacks"]
+    # the dump also lands in the summary for post-hoc readers
+    assert obs.summary()["forensic"]["span"] == "unit.test.span"
+
+
+def test_watchdog_disarm_prevents_fire():
+    obs.enable()
+    wd = health.Watchdog("train", 0.15)
+    try:
+        wd.arm("fast.window")
+        wd.disarm()
+        time.sleep(0.4)
+        assert not wd.fired.is_set()
+        # re-arm works after a disarm
+        wd.arm("second.window")
+        wd.disarm()
+        time.sleep(0.3)
+        assert not wd.fired.is_set()
+    finally:
+        wd.stop()
+    assert "health:stall" not in obs.summary()["events"]
+
+
+def test_forensic_write_is_tmp_plus_rename(tmp_path):
+    """The kill-mid-dump contract: a write that dies mid-payload (the
+    ``snapshot.write`` fault point sits between the payload chunks,
+    same as snapshots) leaves the PREVIOUS published file intact and
+    the torn bytes only in ``.tmp`` — so the published name is valid
+    JSON no matter when a SIGKILL lands."""
+    path = str(tmp_path / "f.forensic.json")
+    d1 = health.build_forensic("span.one", "train", 1.0, {"it": 1})
+    assert health.write_forensic(d1, path) == path
+    assert json.load(open(path))["span"] == "span.one"
+    d2 = health.build_forensic("span.two", "train", 1.0, {"it": 2})
+    faults.inject("snapshot.write", times=1)
+    with pytest.raises(faults.FaultInjected):
+        health.write_forensic(d2, path)
+    faults.clear()
+    # published name: still the previous VALID dump
+    assert json.load(open(path))["span"] == "span.one"
+    # torn bytes stayed in the tmp file
+    torn = open(path + ".tmp").read()
+    with pytest.raises(ValueError):
+        json.loads(torn)
+
+
+def test_injected_stall_during_train_names_window_span(
+        tmp_path, monkeypatch):
+    """End-to-end: watchdog.stall makes the armed training window
+    sleep past the deadline; the forensic dump names the active span
+    while training is still alive, and training then completes."""
+    trace = str(tmp_path / "t.jsonl")
+    monkeypatch.setenv("LGBM_TPU_WATCHDOG_S", "0.25")
+    X, y = _small_data()
+    ds = lgb.Dataset(X, label=y)
+    faults.inject("watchdog.stall", times=1)
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1, "telemetry_output": trace},
+                    ds, num_boost_round=5)
+    assert bst.current_iteration == 5       # the run survived the stall
+    s = obs.summary()
+    assert s["events"].get("health:stall", 0) >= 1
+    assert s["counters"]["watchdog.fires"] >= 1
+    assert health.state()["state"] == "stalled"
+    fp = trace + ".forensic.json"
+    assert os.path.exists(fp)
+    dump = json.load(open(fp))
+    assert dump["span"] in ("gbdt.block", "gbdt.iteration")
+    assert dump["attrs"]["window"] >= 1
+    assert dump["deadline_s"] == 0.25
+    assert "stacks" in dump and "flight_recorder" in dump
+
+
+def test_injected_stall_on_serve_batch(monkeypatch, tmp_path):
+    monkeypatch.setenv("LGBM_TPU_WATCHDOG_S", "0.15")
+    monkeypatch.setenv("LGBM_TPU_FORENSIC",
+                       str(tmp_path / "serve.forensic.json"))
+    obs.enable()
+    from lightgbm_tpu.serve import PredictionServer, compile_model
+    X, y = _small_data(n=800)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 15})
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1}, ds, num_boost_round=3)
+    srv = PredictionServer(compile_model(bst), max_batch=256,
+                           max_wait_ms=1.0, buckets=(64, 256),
+                           min_bucket=64, raw_score=True)
+    faults.inject("watchdog.stall", times=1)
+    fut = srv.submit(X[:3])
+    # exactly-once delivery holds THROUGH the stall: the batch sleeps
+    # past the deadline, gets named, then scores and resolves
+    out = fut.result(60)
+    assert np.asarray(out).shape == (3,)
+    srv.close()
+    s = obs.summary()
+    assert s["events"].get("health:stall", 0) >= 1
+    dump = json.load(open(tmp_path / "serve.forensic.json"))
+    assert dump["span"] == "serve.batch"
+    assert dump["plane"] == "serve"
+
+
+def test_forensic_valid_after_sigkill_midrun(tmp_path):
+    """The r5 failure mode, reproduced and survived: a stalled run is
+    SIGKILLed while still wedged — the already-published forensic
+    file parses and names the stalled span."""
+    trace = str(tmp_path / "k.jsonl")
+    code = (
+        "import numpy as np, lightgbm_tpu as lgb\n"
+        "from lightgbm_tpu.utils import faults\n"
+        "rng = np.random.RandomState(0)\n"
+        "X = rng.normal(size=(400, 4)).astype(np.float32)\n"
+        "y = (X[:, 0] > 0).astype(np.float32)\n"
+        "ds = lgb.Dataset(X, label=y)\n"
+        # every window stalls: the process wedges right after the
+        # first forensic dump and stays wedged until the kill
+        "faults.inject('watchdog.stall', times=100)\n"
+        "lgb.train({'objective': 'binary', 'num_leaves': 7,\n"
+        "           'verbose': -1}, ds, num_boost_round=50)\n"
+    )
+    env = {**os.environ, "JAX_PLATFORMS": "cpu",
+           "LGBM_TPU_WATCHDOG_S": "0.2", "LGBM_TPU_TRACE": trace,
+           "LGBM_TPU_NO_BLOCK": "1",
+           "PYTHONPATH": REPO + os.pathsep + os.environ.get(
+               "PYTHONPATH", "")}
+    proc = subprocess.Popen([sys.executable, "-c", code], env=env,
+                            stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    fp = trace + ".forensic.json"
+    try:
+        deadline = time.time() + 180
+        while not os.path.exists(fp) and time.time() < deadline:
+            time.sleep(0.05)
+        assert os.path.exists(fp), "watchdog never dumped"
+        proc.send_signal(signal.SIGKILL)    # mid-run, mid-stall
+    finally:
+        proc.wait(30)
+    dump = json.load(open(fp))              # valid JSON post-SIGKILL
+    assert dump["span"] in ("gbdt.block", "gbdt.iteration")
+    assert dump["kind"] == "stall_forensic"
+    assert "stacks" in dump and "flight_recorder" in dump
+
+
+# ---------------------------------------------------------------------------
+# numerics sentinels
+# ---------------------------------------------------------------------------
+def test_nan_grad_flips_degraded_with_window(monkeypatch):
+    """ISSUE 13 acceptance: health.nan_grad poisons one gradient
+    element; the sentinel emits health:nonfinite naming the window
+    and /healthz flips to degraded."""
+    monkeypatch.setenv("LGBM_TPU_NO_BLOCK", "1")
+    monkeypatch.setenv("LGBM_TPU_SENTINELS", "1")
+    obs.enable()
+    X, y = _small_data()
+    ds = lgb.Dataset(X, label=y)
+    faults.inject("health.nan_grad", times=1, skip=2)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+              ds, num_boost_round=6)
+    s = obs.summary()
+    assert s["events"].get("fault:health.nan_grad") == 1
+    assert s["events"].get("health:nonfinite") == 1
+    st = health.state()
+    assert st["state"] == "degraded"
+    assert st["detail"]["reason"] == "nonfinite"
+    # the poisoned iteration (third _gradients call, 0-based it=2)
+    assert st["detail"]["window"] == 2
+    # the summary section mirrors it for merged multi-rank summaries
+    assert s["health"]["state"] == "degraded"
+
+
+def test_clean_train_stays_ready_under_sentinels(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_SENTINELS", "1")
+    obs.enable()
+    X, y = _small_data(seed=5)
+    ds = lgb.Dataset(X, label=y)
+    lgb.train({"objective": "binary", "num_leaves": 7, "verbose": -1},
+              ds, num_boost_round=5)
+    s = obs.summary()
+    assert "health:nonfinite" not in s["events"]
+    assert "health:loss_spike" not in s["events"]
+    assert health.state()["state"] == "ready"
+    assert s["counters"]["health.sentinel_checks"] >= 1
+
+
+def test_check_scores_unit():
+    health._set_active(True)
+    obs.enable()
+    assert health.check_scores(np.zeros((8, 1), np.float32), window=1)
+    bad = np.zeros((8, 1), np.float32)
+    bad[3, 0] = np.nan
+    assert not health.check_scores(bad, window=4)
+    assert obs.summary()["events"]["health:nonfinite"] == 1
+    assert health.state()["detail"]["window"] == 4
+    # one-shot: later windows with the same poison do not re-fire
+    assert not health.check_scores(bad, window=5)
+    assert obs.summary()["events"]["health:nonfinite"] == 1
+
+
+def test_loss_spike_sentinel_unit():
+    health._set_active(True)
+    obs.enable()
+    # improving loss: quiet
+    for w, v in enumerate((1.0, 0.8, 0.7)):
+        assert health.check_metrics(
+            [("valid_0", "binary_logloss", v, False)], window=w)
+    # a 3x-best jump: spike
+    assert not health.check_metrics(
+        [("valid_0", "binary_logloss", 2.5, False)], window=3)
+    s = obs.summary()
+    assert s["events"]["health:loss_spike"] == 1
+    st = health.state()
+    assert st["state"] == "degraded" and st["detail"]["window"] == 3
+    # higher-is-better metrics never spike-check (AUC falling is an
+    # early-stopping concern, not a numerics incident)
+    assert health.check_metrics([("valid_0", "auc", 0.1, True)],
+                                window=4)
+    # non-finite metric values trip the nonfinite sentinel
+    assert not health.check_metrics(
+        [("valid_0", "binary_logloss", float("nan"), False)], window=5)
+    assert obs.summary()["events"][
+        "health:nonfinite"] == 1
+
+
+def test_watchdog_seconds_parsing(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_WATCHDOG_S", raising=False)
+    assert health.watchdog_seconds() is None
+    assert health.Watchdog.maybe("train") is None
+    monkeypatch.setenv("LGBM_TPU_WATCHDOG_S", "0")
+    assert health.watchdog_seconds() is None
+    monkeypatch.setenv("LGBM_TPU_WATCHDOG_S", "2.5")
+    assert health.watchdog_seconds() == 2.5
+    monkeypatch.setenv("LGBM_TPU_WATCHDOG_S", "junk")
+    assert health.watchdog_seconds() is None
+
+
+def test_load_harness_sweep_mechanics():
+    """tools/load_harness: the open-loop sweep returns one row per
+    offered-QPS step with ordered tail percentiles and zero failures
+    against a healthy toy server."""
+    from tools.load_harness import _toy_server, sweep
+    srv, X = _toy_server()
+    try:
+        rows = sweep(srv, X, [120.0, 480.0], 0.4, rows_per_request=1,
+                     seed=7)
+    finally:
+        srv.close()
+    assert len(rows) == 2
+    offered = [r["offered_qps"] for r in rows]
+    assert offered == sorted(offered)
+    for r in rows:
+        assert r["requests"] >= 1 and r["failures"] == 0
+        assert r["achieved_qps"] > 0
+        assert r["p999_ms"] >= r["p99_ms"] >= r["p50_ms"] >= 0.0
+        assert r["rows_per_sec"] > 0

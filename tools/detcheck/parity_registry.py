@@ -149,6 +149,21 @@ EXEMPT_ENV: Dict[str, str] = {
                                 "ring; never alters the schedule",
     "LGBM_TPU_FR_CAP": "flight-recorder ring size",
     "LGBM_TPU_FAULTS": "fault-injection arming (chaos runs)",
+    "LGBM_TPU_OPS_PORT": "observability: live /metrics + /healthz + "
+                         "/drain HTTP plane (obs/ops_plane.py); "
+                         "host-side daemon thread mirroring the run "
+                         "summary, never reaches traced programs",
+    "LGBM_TPU_OPS_SKETCH": "ops-plane rolling quantile-sketch window "
+                           "size; reporting resolution only",
+    "LGBM_TPU_WATCHDOG_S": "observability: stall-watchdog deadline "
+                           "(obs/health.py); the monitor thread only "
+                           "observes a wedged dispatch, it never "
+                           "alters what the device computes",
+    "LGBM_TPU_SENTINELS": "observability: numerics sentinels riding "
+                          "window-boundary host fetches; detection "
+                          "only, model state untouched",
+    "LGBM_TPU_SPIKE_FACTOR": "loss-spike sentinel threshold knob",
+    "LGBM_TPU_FORENSIC": "stall-forensics output path override",
     "LGBM_TPU_SYNC_FREQ": "host stop-check cadence: changes when the "
                           "host LOOKS, not what the device computes",
     "LGBM_TPU_BLOCK_CAP": "watchdog bound on iterations per dispatch; "

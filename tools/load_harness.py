@@ -1,0 +1,186 @@
+#!/usr/bin/env python
+"""Open-loop Poisson load harness for a live ``PredictionServer``.
+
+The measurement instrument ROADMAP item 3c specifies: tail latency is
+only a contract if it is measured under OFFERED load, not achieved
+load.  A closed-loop generator (send, wait, send) self-throttles the
+moment the server slows down — exactly when the tail matters — and
+reports flattering percentiles (the classic coordinated-omission
+trap).  This harness is open-loop: request arrival times are drawn
+up-front from a Poisson process at the offered QPS (exponential
+inter-arrival gaps, seeded), each request is submitted at its absolute
+scheduled time whether or not earlier requests have returned, and a
+request's latency is measured from its SCHEDULED arrival to its
+future's completion — queueing delay, coalescing wait, padding, and
+scoring all included, generator slip charged to the server side where
+it belongs.
+
+Per offered-QPS step the sweep records: achieved QPS (completions over
+the step's wall), rows/s, p50/p99/p99.9/mean/max latency (ms),
+failures, and how many submissions slipped past their schedule.
+
+Usage (module or CLI)::
+
+    from tools.load_harness import sweep
+    rows = sweep(server, pool, qps_list=[1000, 5000], duration_s=5.0)
+
+    python tools/load_harness.py --qps 500,2000,8000 --duration 2 \
+        [--model model.txt] [--port 0]
+
+Without ``--model`` a toy booster is trained in-process (mechanics /
+CPU smoke); ``--port`` mounts the ops plane so ``/metrics`` can be
+scraped while the sweep runs.  Output: one JSON line per step plus a
+final ``{"serve_load_table": [...]}`` line (the bench ``serve_load``
+leg consumes :func:`sweep` directly).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+from concurrent.futures import Future
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+# runnable as `python tools/load_harness.py` from anywhere
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def run_step(server, pool: np.ndarray, qps: float, duration_s: float,
+             *, rows_per_request: int = 1, seed: int = 0,
+             timeout_s: float = 120.0) -> Dict:
+    """One open-loop step at ``qps`` offered for ``duration_s``."""
+    rng = np.random.RandomState(seed)
+    n_req = max(1, int(round(qps * duration_s)))
+    # absolute Poisson schedule, drawn before the clock starts
+    arrivals = np.cumsum(rng.exponential(1.0 / qps, size=n_req))
+    done_at: Dict[int, float] = {}
+    futs: List[Future] = []
+    k = rows_per_request
+    n_pool = pool.shape[0]
+    t0 = time.perf_counter()
+    late = 0
+    for i in range(n_req):
+        wait = arrivals[i] - (time.perf_counter() - t0)
+        if wait > 0:
+            time.sleep(wait)
+        else:
+            late += 1           # generator slipped; submit anyway (open loop)
+        off = (i * 131) % max(1, n_pool - k)
+        fu = server.submit(pool[off:off + k])
+
+        def _cb(f, i=i):
+            done_at[i] = time.perf_counter()
+
+        fu.add_done_callback(_cb)
+        futs.append(fu)
+    failures = 0
+    for fu in futs:
+        try:
+            fu.result(timeout=timeout_s)
+        # a failed request still counts against the offered load; its
+        # latency is excluded (there is no completion to measure)
+        except Exception:       # noqa: BLE001 - recorded, not raised
+            failures += 1
+    t_end = time.perf_counter()
+    lat_s = np.asarray([done_at[i] - t0 - arrivals[i]
+                        for i in range(n_req) if i in done_at])
+    ok = n_req - failures
+    wall = max(t_end - t0, 1e-9)
+    row = {
+        "offered_qps": round(float(qps), 1),
+        "achieved_qps": round(ok / wall, 1),
+        "requests": n_req,
+        "failures": failures,
+        "late_submits": late,
+        "rows_per_request": k,
+        "rows_per_sec": round(ok * k / wall, 1),
+        "duration_s": round(wall, 3),
+    }
+    if lat_s.size:
+        row.update({
+            "p50_ms": round(float(np.percentile(lat_s, 50)) * 1e3, 3),
+            "p99_ms": round(float(np.percentile(lat_s, 99)) * 1e3, 3),
+            "p999_ms": round(float(np.percentile(lat_s, 99.9)) * 1e3, 3),
+            "mean_ms": round(float(lat_s.mean()) * 1e3, 3),
+            "max_ms": round(float(lat_s.max()) * 1e3, 3),
+        })
+    return row
+
+
+def sweep(server, pool: np.ndarray, qps_list: Sequence[float],
+          duration_s: float, *, rows_per_request: int = 1, seed: int = 0,
+          emit=None) -> List[Dict]:
+    """Run :func:`run_step` at each offered QPS (low to high so an
+    overloaded server's backlog never bleeds into a lighter step's
+    tail), optionally emitting each row as it lands."""
+    rows = []
+    for i, qps in enumerate(sorted(qps_list)):
+        row = run_step(server, pool, float(qps), duration_s,
+                       rows_per_request=rows_per_request, seed=seed + i)
+        rows.append(row)
+        if emit is not None:
+            emit(row)
+    return rows
+
+
+def _toy_server(features: int = 5, buckets=(64, 256)):
+    """Train a toy booster in-process and wrap it in a server (the
+    no-model CLI path and the CPU smoke test)."""
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.serve import PredictionServer, compile_model
+    rng = np.random.RandomState(3)
+    X = rng.normal(size=(4_000, features)).astype(np.float32)
+    y = (X[:, 0] + X[:, 1] > 0).astype(np.float32)
+    ds = lgb.Dataset(X, label=y, params={"max_bin": 15})
+    bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                     "verbose": -1}, ds, num_boost_round=4)
+    cm = compile_model(bst)
+    srv = PredictionServer(cm, max_batch=max(buckets), max_wait_ms=1.0,
+                           buckets=buckets, min_bucket=min(buckets),
+                           raw_score=True)
+    return srv, X
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--model", default=None,
+                    help="model text file (default: toy in-process train)")
+    ap.add_argument("--qps", default="200,1000",
+                    help="comma-separated offered-QPS sweep")
+    ap.add_argument("--duration", type=float, default=2.0,
+                    help="seconds per sweep step")
+    ap.add_argument("--rows-per-request", type=int, default=1)
+    ap.add_argument("--seed", type=int, default=0)
+    ap.add_argument("--port", default=None,
+                    help="mount the ops plane on this port "
+                         "(sets LGBM_TPU_OPS_PORT; 0 = ephemeral)")
+    args = ap.parse_args(argv)
+    if args.port is not None:
+        os.environ["LGBM_TPU_OPS_PORT"] = str(args.port)
+    if args.model:
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.serve import PredictionServer, compile_model
+        cm = compile_model(lgb.Booster(model_file=args.model))
+        srv = PredictionServer(cm, raw_score=True)
+        rng = np.random.RandomState(args.seed)
+        pool = rng.normal(size=(8_192, cm.num_features)).astype(np.float32)
+    else:
+        srv, pool = _toy_server()
+    qps_list = [float(q) for q in args.qps.split(",") if q.strip()]
+    try:
+        rows = sweep(srv, pool, qps_list, args.duration,
+                     rows_per_request=args.rows_per_request,
+                     seed=args.seed,
+                     emit=lambda r: print(json.dumps(r), flush=True))
+    finally:
+        srv.close()
+    print(json.dumps({"serve_load_table": rows}), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

@@ -42,6 +42,42 @@ def load_records(paths):
     return records
 
 
+def health_block(events, counters, state=None, ranks=None,
+                 out=sys.stdout):
+    """The "Health" section (ISSUE 13): live-plane state, watchdog
+    arms/fires, sentinel trips, and every ``health:*`` event — the
+    post-hoc rendering of what ``/healthz`` + ``/metrics`` served
+    live.  Skipped entirely when the run carried no health signal."""
+    h_events = {k: v for k, v in events.items() if k.startswith("health:")}
+    h_counters = {k: v for k, v in counters.items()
+                  if k.startswith(("watchdog.", "health."))}
+    if not (h_events or h_counters or state or ranks):
+        return
+    print("\n== health ==", file=out)
+    if state:
+        det = state.get("detail") or {}
+        extra = (" (" + ", ".join(f"{k}={v}" for k, v in det.items())
+                 + ")") if det else ""
+        print(f"  state: {state.get('state', '?')}{extra}", file=out)
+    if ranks:
+        per = ", ".join(f"rank{r}={s or '?'}"
+                        for r, s in enumerate(ranks.get("ranks", [])))
+        print(f"  per-rank: {per}    worst: {ranks.get('worst')}",
+              file=out)
+    arms = int(h_counters.get("watchdog.arms", 0))
+    fires = int(h_counters.get("watchdog.fires", 0))
+    if arms or fires:
+        print(f"  watchdog: {arms} arm(s), {fires} fire(s)", file=out)
+    checks = int(h_counters.get("health.sentinel_checks", 0))
+    trips = (int(h_counters.get("health.nonfinite", 0))
+             + int(h_counters.get("health.loss_spikes", 0)))
+    if checks or trips:
+        print(f"  sentinels: {checks} check(s), {trips} trip(s)",
+              file=out)
+    for name in sorted(h_events):
+        print(f"  {name:<38s} {h_events[name]:>12d}", file=out)
+
+
 def report(records, out=sys.stdout):
     spans = defaultdict(lambda: [0, 0.0, 0.0, 0])   # count,total,max,min_depth
     counters = {}
@@ -85,6 +121,7 @@ def report(records, out=sys.stdout):
         print("\nevents:", file=out)
         for name in sorted(events):
             print(f"  {name:<40s} {events[name]:>12d}", file=out)
+    health_block(events, counters, out=out)
 
 
 def _try_summary(path):
@@ -111,6 +148,13 @@ def report_summary(s, out=sys.stdout):
     for name, v in sorted(spans.items(), key=lambda kv: -kv[1]["total_s"]):
         print(f"{name:<28s} {v['count']:>7d} {v['total_s']:>10.3f} "
               f"{v['max_s']:>9.3f}", file=out)
+    # Health section: a single-rank summary carries its own `health`
+    # state; a merged multi-rank summary carries the per-rank lift
+    # (telemetry.merged_summary) — both render here
+    hstate = s.get("health") if "state" in (s.get("health") or {}) else None
+    hranks = s.get("health") if "ranks" in (s.get("health") or {}) else None
+    health_block(s.get("events", {}), s.get("counters", {}),
+                 state=hstate, ranks=hranks, out=out)
     da = s.get("device_attribution")
     if da:
         print("\n== device attribution (LGBM_TPU_PROFILE capture) ==",
