@@ -1154,6 +1154,181 @@ def multichip_child():
     print("MULTICHIP_CHILD:" + json.dumps(out), flush=True)
 
 
+# keys the stream_ingest (out-of-core) leg must emit — `--dryrun`
+# validates them plus the byte-identity and SIGKILL-resume gates
+STREAM_SCHEMA_KEYS = (
+    "stream_rows", "stream_block_rows", "stream_shards", "stream_iters",
+    "stream_ingest_rows_per_sec", "stream_row_iters_per_sec",
+    "stream_identity_ok", "stream_resume_ok",
+    "stream_host_rss_peak_bytes", "stream_model_digest")
+
+
+def stream_ingest_leg(line=None, dryrun: bool = False):
+    """Out-of-core streamed training (ISSUE 14, ROADMAP item 4): rows
+    live in the mmap binned shard store (`io/outofcore.py`) and stream
+    through the device block-by-block (`boosting/streaming.py`) — the
+    leg that trains a dataset that was never going to fit.
+
+    Phases (each emitted incrementally when ``line`` is given, so a
+    SIGKILL mid-leg keeps everything that ran):
+
+    1. **resume mechanics** — a REAL SIGKILL mid-ingest in a
+       subprocess (``bench.py --stream-child``), then a resuming
+       ingest whose manifest must equal a clean ingest's
+       (``stream_resume_ok``);
+    2. **byte-identity gate** at a fittable size: streamed training ==
+       resident in-memory training, model + score digests, on the
+       exact-accumulation scatter backend (forced on TPU for the gate;
+       the CPU default) — ``stream_identity_ok``;
+    3. **scale phase**: ingest ≥100M synthetic rows (toy shape in
+       ``--dryrun``) shard-by-shard into the store, then streamed
+       training, recording ingest rows/s, train row-iters/s, the
+       device HBM peak (must track LGBM_TPU_STREAM_ROWS, not dataset
+       rows — memcheck MEM003 `stream_100m` models the same claim),
+       and the process host-RSS peak (``ru_maxrss``: the host memory
+       wall half of the contract).
+    """
+    import resource
+    import shutil
+    import signal as _signal
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    from lightgbm_tpu.boosting.gbdt import GBDT
+    from lightgbm_tpu.boosting.streaming import StreamTrainer
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io import outofcore as oc
+    import jax
+
+    toy = dryrun or jax.default_backend() != "tpu"
+    rows = int(os.environ.get("BENCH_STREAM_ROWS",
+                              24_576 if toy else 100_000_000))
+    block = int(os.environ.get("BENCH_STREAM_BLOCK",
+                               8_192 if toy else 1 << 20))
+    iters = int(os.environ.get("BENCH_STREAM_ITERS", 2))
+    leaves = 15 if toy else 63
+    f = 6 if toy else 28
+    params = {"objective": "binary", "num_leaves": leaves, "max_bin": 63,
+              "learning_rate": 0.1, "verbose": -1}
+    cfg = Config.from_params(params)
+    out = {"stream_rows": rows, "stream_block_rows": block,
+           "stream_iters": iters}
+
+    def _partial(stage):
+        if line is not None:
+            line.update(out)
+            line["partial"] = stage
+            _emit(line)
+
+    tmp = tempfile.mkdtemp(prefix="lgbm_stream_")
+    try:
+        # 1) SIGKILL-resume mechanics (subprocess; three shards, child
+        # dies after publishing the first shard's sidecar)
+        kid = os.path.join(tmp, "kill")
+        argv = [_sys.executable, os.path.abspath(__file__),
+                "--stream-child", kid, str(3 * block), str(f), "63",
+                str(block)]
+        proc = subprocess.run(argv, capture_output=True, timeout=600)
+        killed = proc.returncode == -_signal.SIGKILL
+        manifest_absent = not os.path.exists(os.path.join(kid, oc.MANIFEST))
+        resumed = oc.ingest_synthetic(kid, 3 * block, f, cfg, seed=0,
+                                      shard_rows=block)
+        clean = oc.ingest_synthetic(os.path.join(tmp, "cleanref"),
+                                    3 * block, f, cfg, seed=0,
+                                    shard_rows=block)
+        out["stream_resume_ok"] = bool(
+            killed and manifest_absent
+            and resumed.manifest["key"] == clean.manifest["key"]
+            and [s["sha256"] for s in resumed.manifest["shards"]]
+            == [s["sha256"] for s in clean.manifest["shards"]])
+        _partial("stream-resume")
+
+        # 2) byte-identity gate at a fittable size (scatter fold on
+        # both sides — the exact-accumulation contract's domain)
+        ident_rows = rows if toy else int(
+            os.environ.get("BENCH_STREAM_IDENT_ROWS", 262_144))
+        prev_backend = os.environ.get("LGBM_TPU_HIST_BACKEND")
+        os.environ["LGBM_TPU_HIST_BACKEND"] = "scatter"
+        try:
+            st = oc.ingest_synthetic(
+                os.path.join(tmp, "ident"), ident_rows, f, cfg, seed=1,
+                shard_rows=max(block, ident_rows // 3))
+            d_str = StreamTrainer(cfg, st, block_rows=block) \
+                .train(iters).digest()
+            g = GBDT(Config.from_params(params), st.to_binned_dataset(cfg))
+            g.train(iters)
+            out["stream_identity_rows"] = ident_rows
+            out["stream_identity_ok"] = bool(d_str == g.digest())
+            del g
+        finally:
+            if prev_backend is None:
+                os.environ.pop("LGBM_TPU_HIST_BACKEND", None)
+            else:
+                os.environ["LGBM_TPU_HIST_BACKEND"] = prev_backend
+        _partial("stream-identity")
+
+        # 3) scale phase: shard-by-shard ingest (SIGKILL-survivable by
+        # construction), then streamed training
+        import gc
+        gc.collect()
+        t0 = time.time()
+        big = oc.ingest_synthetic(
+            os.path.join(tmp, "big"), rows, f, cfg, seed=2,
+            shard_rows=max(block, rows // (3 if toy else 32)))
+        t_ing = time.time() - t0
+        out["stream_shards"] = len(big.manifest["shards"])
+        out["stream_ingest_rows_per_sec"] = round(rows / max(t_ing, 1e-9),
+                                                  1)
+        _partial("stream-ingest")
+        tr = StreamTrainer(cfg, big, block_rows=block)
+        t0 = time.time()
+        bst = tr.train(iters)
+        wall = time.time() - t0
+        out["stream_train_s"] = round(wall, 3)
+        out["stream_row_iters_per_sec"] = round(rows * iters / wall, 1)
+        out["stream_model_digest"] = bst.digest(include_scores=False)
+        # host memory wall: process peak RSS (lifetime watermark — at
+        # 100M rows the streamed state is scores+grad+hess ≈ 12 bytes/
+        # row host-side, and the mmap'd store pages stay evictable)
+        out["stream_host_rss_peak_bytes"] = \
+            resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)
+    return out
+
+
+def stream_child():
+    """``bench.py --stream-child <cache> <rows> <features> <max_bin>
+    <shard_rows>``: ingest a synthetic store and SIGKILL ourselves
+    right after the FIRST shard's sidecar publishes — the crash the
+    resume gate proves survivable."""
+    import signal as _signal
+    import sys as _sys
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io import outofcore as oc
+    cache, rows, f, max_bin, shard_rows = (
+        _sys.argv[2], int(_sys.argv[3]), int(_sys.argv[4]),
+        int(_sys.argv[5]), int(_sys.argv[6]))
+    cfg = Config.from_params({"objective": "binary", "max_bin": max_bin,
+                              "verbose": -1})
+    orig = oc.atomic_write
+    seen = {"sidecars": 0}
+
+    def killer(path, payload, **kw):
+        orig(path, payload, **kw)
+        if os.path.basename(path).startswith("shard-") \
+                and path.endswith(".json"):
+            seen["sidecars"] += 1
+            if seen["sidecars"] == 1:
+                os.kill(os.getpid(), _signal.SIGKILL)
+
+    oc.atomic_write = killer
+    oc.ingest_synthetic(cache, rows, f, cfg, seed=0,
+                        shard_rows=shard_rows)
+
+
 def _validate_north_star_aux(ns: dict):
     """Validate the extended north_star.json tables: each aux wave key
     is either a measured list of rows (positive ns/row) or a
@@ -1239,6 +1414,22 @@ def _validate_north_star_aux(ns: dict):
     detail["device_attribution"] = ("measured" if measured_att else
                                     ("pending-capture" if good
                                      else "invalid"))
+    ok = ok and good
+    # stream_ingest (ISSUE 14): a measured dict with positive streamed
+    # row-iters/s + passing identity/resume gates, or an explicit
+    # pending-capture spec naming the target scale
+    si = ns.get("stream_ingest")
+    measured_si = isinstance(si, dict) and "row_iters_per_sec" in si
+    if measured_si:
+        good = (float(si.get("row_iters_per_sec", 0)) > 0
+                and bool(si.get("identity_ok"))
+                and bool(si.get("resume_ok")))
+    else:
+        good = (isinstance(si, dict)
+                and si.get("status") == "pending-capture"
+                and int(si.get("rows", 0)) >= 100_000_000)
+    detail["stream_ingest"] = ("measured" if measured_si and good else
+                               ("pending-capture" if good else "invalid"))
     return ok and good, detail
 
 
@@ -1373,6 +1564,28 @@ def dryrun_main():
     except Exception as exc:        # noqa: BLE001 - reported on the line
         line["serve_load_ok"] = False
         line["serve_load_leg"] = f"failed: {type(exc).__name__}: {exc}"
+    # stream_ingest gate (ISSUE 14): the REAL out-of-core leg at toy
+    # shape — multi-block streamed training byte-identical to resident,
+    # a REAL SIGKILL mid-ingest resuming to the clean manifest, and the
+    # schema the TPU artifact will record (tier-1 via
+    # tests/test_bench_budget)
+    try:
+        stleg = stream_ingest_leg(dryrun=True)
+        missing = [k for k in STREAM_SCHEMA_KEYS if k not in stleg]
+        line.update(stleg)
+        line["stream_schema_ok"] = bool(
+            not missing
+            and stleg["stream_identity_ok"]
+            and stleg["stream_resume_ok"]
+            and stleg["stream_ingest_rows_per_sec"] > 0
+            and stleg["stream_row_iters_per_sec"] > 0
+            and stleg["stream_shards"] > 1
+            and stleg["stream_host_rss_peak_bytes"] > 0)
+        if missing:
+            line["stream_schema_missing"] = missing
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["stream_schema_ok"] = False
+        line["stream_leg"] = f"failed: {type(exc).__name__}: {exc}"
     # device-time attribution gate (ISSUE 10): the REAL leg at toy
     # shape on CPU — windowed capture, parse, schema — with the
     # acceptance floor: >=90% of captured device time attributes to
@@ -1433,10 +1646,11 @@ def dryrun_main():
     # carries the field — a positive int where the backend exposes
     # allocator stats, null + peak_hbm_reason where it doesn't (CPU) —
     # validated as peak_hbm_schema_ok (tier-1, tests/test_bench_budget)
-    for prefix in (None, "waves", "multichip", "serve"):
+    for prefix in (None, "waves", "multichip", "serve", "stream"):
         _peak_field(line, prefix)
     peak_keys = ("peak_hbm_bytes", "waves_peak_hbm_bytes",
-                 "multichip_peak_hbm_bytes", "serve_peak_hbm_bytes")
+                 "multichip_peak_hbm_bytes", "serve_peak_hbm_bytes",
+                 "stream_peak_hbm_bytes")
     line["peak_hbm_schema_ok"] = all(
         k in line and (
             (isinstance(line[k], int) and line[k] > 0)
@@ -1752,6 +1966,21 @@ def main():
                 auc_ok = False
         _checkpoint("headline-full+multichip")
 
+    # stream_ingest (ISSUE 14): out-of-core streamed training — ingest
+    # >=100M synthetic rows into the mmap shard store and train beyond
+    # resident memory, with the byte-identity and SIGKILL-resume gates.
+    # Gate-bearing: a failed identity/resume gate zeroes the headline
+    # (a streamed model that silently diverges must not score).
+    if os.environ.get("BENCH_STREAM", "1") != "0":
+        stleg = _leg(line, "stream", lambda: stream_ingest_leg(line),
+                     gate=True)
+        if stleg is not None:
+            line.update(stleg)
+            if not (stleg.get("stream_identity_ok")
+                    and stleg.get("stream_resume_ok")):
+                auc_ok = False
+        _checkpoint("aux-stream")
+
     # 255-bin leg (VERDICT r4 #7): the EXACT docs/Experiments.rst:104-116
     # bin/leaf config (max_bin=255, 255 leaves) at reduced iterations, so
     # the CPU comparison has an apples-to-apples anchor (the 238.5 s CPU
@@ -1891,6 +2120,8 @@ if __name__ == "__main__":
     import sys
     if "--multichip-child" in sys.argv:
         multichip_child()
+    elif "--stream-child" in sys.argv:
+        stream_child()
     elif "--dryrun" in sys.argv:
         dryrun_main()
     else:

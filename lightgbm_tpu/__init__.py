@@ -24,6 +24,7 @@ __all__ = [
     "reset_parameter", "EarlyStopException", "telemetry",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_tree",
+    "train_streaming", "outofcore",
 ]
 
 
@@ -38,4 +39,11 @@ def __getattr__(name):
     if name == "serve":
         from . import serve as _serve
         return _serve
+    if name == "train_streaming":
+        # lazy: the out-of-core trainer pulls in the learner stack
+        from .boosting.streaming import train_streaming as _ts
+        return _ts
+    if name == "outofcore":
+        from .io import outofcore as _oc
+        return _oc
     raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

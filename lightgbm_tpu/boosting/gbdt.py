@@ -1239,17 +1239,18 @@ class GBDT:
                 # (and GOSS: _block_sample override) configs stay on
                 # the fused fast path
                 G, H, bag = self._block_sample(G, H, it)
-                if mesh_build is not None:
-                    # BYTE-identity fence vs the per-iteration mesh
-                    # path: eagerly, gradients materialize as f32
-                    # program outputs before the build consumes them;
-                    # fused, XLA would contract producer/consumer
-                    # mul+add chains into FMAs with different last-ulp
-                    # rounding.  The barrier reproduces the eager
-                    # program boundary at zero runtime cost.
-                    G, H = jax.lax.optimization_barrier((G, H))
-                    if bag is not None:
-                        bag = jax.lax.optimization_barrier(bag)
+                # BYTE-identity fence (serial AND mesh since the
+                # out-of-core round): eagerly — and in the streamed
+                # trainer's standalone per-block programs — gradients
+                # materialize as f32 program outputs before the build
+                # consumes them; fused, XLA would contract producer/
+                # consumer mul+add chains into FMAs with different
+                # last-ulp rounding.  The barrier reproduces that
+                # program boundary at zero runtime cost, which is what
+                # lets boosting/streaming.py match this body bitwise.
+                G, H = jax.lax.optimization_barrier((G, H))
+                if bag is not None:
+                    bag = jax.lax.optimization_barrier(bag)
                 outs = []
                 for k in range(K):
                     fmask = (_device_feature_mask(c.feature_fraction_seed,
@@ -1287,6 +1288,17 @@ class GBDT:
                                                           vd.bins))
                             for vs, vd in zip(vscores, vds))
                     else:
+                        # serial branch fenced like the mesh branch
+                        # since the out-of-core round: the barrier
+                        # keeps the build subgraph's fusion identical
+                        # to its standalone jit, and the updates use
+                        # the contraction-proof scale-then-gather /
+                        # scale-then-predict shapes — so the streamed
+                        # trainer's standalone per-block dispatches
+                        # (boosting/streaming.py) reproduce the same
+                        # last-ulp rounding in any fusion context
+                        bt = jax.lax.optimization_barrier(bt)
+                        lv_s = lr * bt.leaf_value            # [L]
                         if bt.row_value.shape[0]:
                             # emitted by the final route kernel (already
                             # stump-masked); avoids the 1M-row gather
@@ -1294,17 +1306,18 @@ class GBDT:
                                 lr * bt.row_value)
                         else:
                             scores = scores.at[:, k].add(
-                                lr * lv[bt.row_leaf])
+                                lv_s[bt.row_leaf])
                         # valid-set scoring per tree, on device: the
                         # path-agreement matmul (MXU) for numerical
                         # valid sets, the node walk where categorical
                         # splits need the bitset decision
+                        bts = bt._replace(leaf_value=lv_s)
                         vscores = tuple(
-                            vs.at[:, k].add(lr * (
-                                predict_built_tree(bt, vd, vd.bins)
+                            vs.at[:, k].add(
+                                predict_built_tree(bts, vd, vd.bins)
                                 if vd.has_categorical else
-                                predict_built_tree_matmul(bt, vd,
-                                                          vd.bins)))
+                                predict_built_tree_matmul(bts, vd,
+                                                          vd.bins))
                             for vs, vd in zip(vscores, vds))
                     outs.append(bt._replace(row_leaf=bt.row_leaf[:0],
                                             row_value=bt.row_value[:0]))

@@ -1,0 +1,715 @@
+"""Streaming block trainer — out-of-core training (ROADMAP item 4).
+
+Rows live in the mmap-able binned shard cache (``io/outofcore.py``),
+NOT in HBM: per tree, row blocks of ``LGBM_TPU_STREAM_ROWS`` stream
+host→device one wave at a time, each block is routed through the
+partial tree and its per-wave histograms accumulate into the resident
+``[L, F, B, 3]`` state — the histogram trick is what makes GBDT
+uniquely streamable (one pass over the data per wave, no resident
+rows).  Per-device HBM scales with the block size, never with dataset
+rows (memcheck MEM003 models it; the bench ``stream_ingest`` leg's
+watermark proves it).  Scores, gradients and hessians are host-
+resident and updated per block as the blocks stream.
+
+**Byte-identity contract** (the DET005 seam ``LGBM_TPU_STREAM_ROWS``,
+pinned by tests/test_streaming.py): on the exact-accumulation scatter
+histogram backend (the default off-TPU), streamed training is
+BYTE-IDENTICAL — model text and score digests via ``Booster.digest()``
+— to in-memory ``lgb.train`` on the same data, serial AND 2-shard
+data-parallel.  Three mechanisms make that possible:
+
+1. **Carried-accumulator scatter folds.**  XLA applies same-location
+   scatter-add updates in row order, so folding per-block scatters
+   into a carried ``[A, F, B, 3]`` accumulator reproduces the
+   monolithic ``hist_active_scatter`` bitwise; the parity test is the
+   gate.  On the Pallas/compact kernels (TPU) the per-block partials
+   are ADDED instead (through the shared ``make_hist_fn`` seam) — the
+   documented last-ulp class — so the on-device identity gate pins the
+   scatter path while the throughput leg rides the kernels.
+2. **Canonical chunked root statistics** (``learner/serial.py
+   root_stats``): the resident ``_init_state`` derives the root sums
+   from fixed ``STREAM_CHUNK``-sized chunk sums reduced by a fixed
+   pairwise tree — partition-invariant, so this trainer reassembles
+   the identical scalars from per-block chunk sums.
+3. **The fenced block body** (``gbdt._make_block_fn``): the serial
+   scan body barriers gradients and the built tree and updates scores
+   with the contraction-proof scale-then-gather shape (the PR 11 mesh
+   discipline), so this module's standalone per-block programs compile
+   to the same last-ulp rounding as the fused in-memory body.
+
+2-shard data-parallel composes by mirroring the mesh row partition
+(``parallel/mesh.py shard_row_ranges``): each shard's blocks fold into
+a per-shard accumulator and the shard partials combine in device order
+— elementwise adds, exactly what the wave ``psum`` lowers to — so the
+streamed model equals the in-memory 2-shard mesh model bitwise.  The
+in-memory data-parallel psum schedule itself is untouched.
+
+Supported: gbdt boosting, row-wise objectives (regression / binary /
+multiclass / xentropy families), ``feature_fraction``, weights, serial
+and data-parallel layouts.  Documented descopes (they raise):
+bagging/GOSS (the [n]-shaped device mask breaks the memory contract),
+DART (host score patching), ranking (row blocks would split queries),
+custom ``fobj``, leaf-renewal objectives, valid sets / early stopping.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import Config
+from ..io.dataset import BinnedDataset, Metadata
+from ..io.device import DeviceData, feature_meta_np
+from ..learner.serial import (STREAM_CHUNK, BuiltTree, _WaveState,
+                              _apply_wave, _empty_best, apply_hist_wave,
+                              make_hist_fn, reduce_chunk_sums,
+                              resolve_backend, root_chunk_sums, scan_grid,
+                              stage_plan, uses_pallas)
+from ..obs import counter_add, span as obs_span
+from ..objective.objectives import create_objective
+from ..ops.pallas_histogram import bin_stride
+from ..ops.pallas_route import route_rows_xla
+from ..ops.split import leaf_output as _leaf_output
+from ..utils.log import log_info, log_warning
+from .gbdt import GBDT, _device_feature_mask, growth_params_from_config
+
+# past this row count the objective's device-label init is skipped (it
+# would pin an [n] f32 in HBM) and boost-from-average binds the host
+# label vector directly
+_RESIDENT_SIDE_ROWS = 1 << 27
+
+
+def stream_rows() -> int:
+    """The configured streaming block size (``LGBM_TPU_STREAM_ROWS``),
+    rounded UP to a multiple of ``STREAM_CHUNK`` — block boundaries
+    must land on root-statistic chunk boundaries or the partition-
+    invariant reduction contract breaks.  0 = streaming off."""
+    r = int(os.environ.get("LGBM_TPU_STREAM_ROWS", "0"))
+    if r <= 0:
+        return 0
+    return -(-r // STREAM_CHUNK) * STREAM_CHUNK
+
+
+class _Source:
+    """Uniform block reader over a ShardStore or a resident
+    BinnedDataset (the resident form exists so source independence —
+    mmap cache vs RAM — is testable, and so the parity harness can
+    stream the exact arrays the in-memory path trains on)."""
+
+    def __init__(self, obj, config: Config):
+        from ..io.outofcore import ShardStore
+        self._store = obj if isinstance(obj, ShardStore) else None
+        self._ds = obj if isinstance(obj, BinnedDataset) else None
+        if self._store is None and self._ds is None:
+            raise TypeError(f"unsupported stream source {type(obj)!r}")
+        if self._ds is not None and self._ds.bundle is not None \
+                and self._ds.bundle.is_bundled:
+            raise ValueError("streaming does not support EFB-bundled "
+                             "resident sources (the shard store ingests "
+                             "unbundled)")
+        self.config = config
+
+    @property
+    def n(self) -> int:
+        return (self._store.n if self._store is not None
+                else self._ds.num_data)
+
+    @property
+    def num_features(self) -> int:
+        return (self._store.num_features if self._store is not None
+                else self._ds.num_features)
+
+    def read_rows(self, start: int, stop: int):
+        if self._store is not None:
+            return self._store.read_rows(start, stop)
+        md = self._ds.metadata
+        return (self._ds.bins[start:stop],
+                md.label[start:stop] if md.label is not None else
+                np.zeros(stop - start, np.float32),
+                md.weight[start:stop] if md.weight is not None else None)
+
+    def labels(self) -> np.ndarray:
+        return (self._store.labels_array() if self._store is not None
+                else self._ds.metadata.label)
+
+    def weights(self) -> Optional[np.ndarray]:
+        return (self._store.weights_array() if self._store is not None
+                else self._ds.metadata.weight)
+
+    def query_boundaries(self):
+        return (None if self._store is not None
+                else self._ds.metadata.query_boundaries)
+
+    def light_dataset(self) -> BinnedDataset:
+        """A bins-free BinnedDataset shell carrying mappers/feature
+        metadata — enough for model IO (``GBDT._to_host_tree`` reads
+        mappers and ``used_features``, never the rows)."""
+        if self._ds is not None:
+            return self._ds
+        st = self._store
+        ds = BinnedDataset()
+        ds.config = self.config
+        ds.num_total_features = st.num_total_features
+        ds.feature_names = list(st.feature_names)
+        ds.mappers = st.mappers
+        ds.used_features = list(st.used_features)
+        ds.feature_info = st.feature_info
+        ds.bins = np.zeros((0, st.num_features), st.dtype)
+        return ds
+
+
+def _check_streamable(config: Config, objective, src: _Source) -> None:
+    bad = None
+    if config.boosting_type not in ("gbdt",):
+        bad = f"boosting={config.boosting_type} (host score patching)"
+    elif config.bagging_freq > 0 and config.bagging_fraction < 1.0:
+        bad = "bagging (the [n]-shaped device mask breaks the " \
+              "block-memory contract)"
+    elif config.tree_learner not in ("serial", "data"):
+        bad = f"tree_learner={config.tree_learner} (streamed v1 " \
+              "composes with data-parallel row sharding only)"
+    elif objective is None:
+        bad = "objective=none / custom fobj"
+    elif objective.need_renew_tree_output:
+        bad = f"objective={objective.name} (leaf renewal rewrites " \
+              "outputs from per-row scores)"
+    elif "rank" in objective.name or src.query_boundaries() is not None:
+        bad = "ranking objectives (row blocks would split queries)"
+    if bad:
+        raise ValueError(
+            f"streaming training does not support {bad}; train "
+            "in-memory, or see README \"Out-of-core training\" for the "
+            "supported envelope")
+
+
+def _num_shards(config: Config) -> int:
+    if config.tree_learner != "data":
+        return 1
+    shape = tuple(config.mesh_shape) or (len(jax.devices()),)
+    return max(1, int(shape[0]))
+
+
+class StreamTrainer:
+    """Host-driven streamed boosting over a block source.
+
+    Produces a regular :class:`~lightgbm_tpu.boosting.gbdt.GBDT` (model
+    IO, ``digest()``, prediction through the mapper shell) whose train
+    scores are the streamed host-resident score state."""
+
+    def __init__(self, config: Config, source, block_rows: int = 0):
+        self.config = config
+        self.src = _Source(source, config)
+        self.R = block_rows or stream_rows() or STREAM_CHUNK
+        self.R = -(-self.R // STREAM_CHUNK) * STREAM_CHUNK
+        self.S = _num_shards(config)
+        n = self.src.n
+        if n <= 0:
+            raise ValueError("empty stream source")
+        self.n = n
+        from ..parallel.mesh import shard_row_ranges
+        self.ranges = shard_row_ranges(n, self.S)
+        self.per = self.ranges[0][1] - self.ranges[0][0]
+
+        booster = GBDT(config, None)
+        booster.train_set = self.src.light_dataset()
+        booster.growth = growth_params_from_config(config)
+        booster.feature_names = booster.train_set.feature_names
+        booster.max_feature_idx = booster.train_set.num_total_features - 1
+        self.booster = booster
+        self.growth = booster.growth
+
+        self.objective = create_objective(config)
+        _check_streamable(config, self.objective, self.src)
+        self.K = self.objective.num_model_per_iteration
+        booster.num_tree_per_iteration = self.K
+        # the saved model text must carry the objective header (predict
+        # conversion + continued training on reload)
+        booster.objective = self.objective
+
+        light = self.src.light_dataset()
+        meta = feature_meta_np(light)
+        arrays = {k: jnp.asarray(meta[k]) for k in (
+            "bin_offsets", "num_bins", "default_bins", "missing_types",
+            "is_categorical", "nan_bins", "feat_group", "feat_offset")}
+        self._dtype = light.bins.dtype
+        # template DeviceData: per-block `bins` swap in, metadata fixed
+        self.dd_meta = DeviceData(
+            bins=jnp.zeros((self.R, self.src.num_features), self._dtype),
+            total_bins=meta["total_bins"], max_bins=meta["max_bins"],
+            has_categorical=meta["has_categorical"],
+            max_group_bins=meta["max_group_bins"],
+            is_bundled=meta["is_bundled"],
+            has_missing=meta["has_missing"], **arrays)
+        L = self.growth.num_leaves
+        self.L = L
+        _, self.A_tail = stage_plan(L, self.growth.wave_size)
+        self.Bh = bin_stride(self.dd_meta.group_max_bins)
+        from ..learner.serial import default_hist_mode, effective_hist_mode
+        self.hist_mode = effective_hist_mode(
+            config.hist_mode or default_hist_mode(), self.R)
+        self.backend = resolve_backend(self.dd_meta, L,
+                                       hist_mode=self.hist_mode)
+        # exact-accumulation contract on "scatter"; kernel backends fold
+        # per-block partials through the shared make_hist_fn seam
+        self._kernel_hist = uses_pallas(self.backend)
+
+        # host score state [n, K] f32 — the training state that would
+        # not fit in HBM; every update happens on device per block and
+        # lands back here bitwise
+        self.scores = np.zeros((n, self.K), np.float32)
+        self._init_scores()
+        self._jits = {}
+
+    # -- init ------------------------------------------------------------
+    def _init_scores(self) -> None:
+        obj = self.objective
+        if not self.config.boost_from_average:
+            return
+        y = np.ascontiguousarray(self.src.labels(), np.float32)
+        w = self.src.weights()
+        if self.n <= _RESIDENT_SIDE_ROWS:
+            # the in-memory init path verbatim (device label freed right
+            # after): bitwise-identical init score at fittable sizes
+            md = Metadata()
+            md.set_field("label", y)
+            if w is not None:
+                md.set_field("weight", np.ascontiguousarray(w))
+            obj.init(md, self.n)
+            obj.label = None
+            obj.weight = None
+        else:
+            if getattr(self.config, "reg_sqrt", False):
+                raise ValueError("reg_sqrt streaming past "
+                                 f"{_RESIDENT_SIDE_ROWS} rows is not "
+                                 "supported")
+            obj._label_np = y
+            obj._weight_np = (np.ascontiguousarray(w, np.float32)
+                              if w is not None else None)
+            obj._check_label()
+        v = obj.boost_from_score()
+        if v != 0.0:
+            self.booster.init_score_value = v
+            self.scores[:] = np.float32(v)
+            log_info(f"boost from average: init score = {v:.6f}")
+
+    # -- jitted per-step programs ---------------------------------------
+    def _jit(self, name, fn, **kw):
+        if name not in self._jits:
+            self._jits[name] = jax.jit(fn, **kw)
+        return self._jits[name]
+
+    def _grad_fn(self):
+        obj = self.objective
+        K = self.K
+
+        def grads(scores_b, label_b, weight_b):
+            # bind the block's label/weight for the trace; row-wise
+            # objectives make the block slice exact vs the full call
+            obj.label = label_b
+            obj.weight = weight_b
+            try:
+                if K == 1:
+                    g, h = obj.get_gradients(scores_b[:, 0])
+                    return g[:, None], h[:, None]
+                return obj.get_gradients(scores_b)
+            finally:
+                obj.label = None
+                obj.weight = None
+        return self._jit("grads", grads)
+
+    def _hist_into(self, acc, bins, grad, hess, hist_leaf, active):
+        """Scatter one block's rows INTO the carried accumulator —
+        the ``hist_active_scatter`` index arithmetic seeded with the
+        fold carry, so the per-location add order equals the monolithic
+        scatter's row order (the exactness contract)."""
+        A = active.shape[0]
+        F = bins.shape[1]
+        B = self.Bh
+        L = self.L
+        safe_act = jnp.where(active >= 0, active, L)
+        inv = jnp.full((L + 1,), A, jnp.int32).at[safe_act].set(
+            jnp.arange(A, dtype=jnp.int32), mode="drop")
+        slot = jnp.where(hist_leaf >= 0,
+                         inv[jnp.clip(hist_leaf, 0, L)], A)
+        idx = (slot[:, None] * (F * B)
+               + jnp.arange(F, dtype=jnp.int32)[None, :] * B
+               + bins.astype(jnp.int32))
+        vals = jnp.stack([grad, hess, jnp.ones_like(grad)], -1)
+        flat = acc.reshape(A * F * B, 3).at[idx].add(
+            vals[:, None, :].astype(jnp.float32), mode="drop")
+        return flat.reshape(A, F, B, 3)
+
+    def _route(self, data: DeviceData, leaf2, best, pend_sel, pend_new):
+        def do_route(l2):
+            return route_rows_xla(
+                data.bins, l2, best.feature, best.threshold,
+                best.default_left, best.is_categorical, best.cat_mask,
+                pend_sel, pend_new, data.missing_types, data.nan_bins,
+                data.default_bins, data.feat_group, data.feat_offset,
+                data.num_bins)
+        return jax.lax.cond(jnp.any(pend_sel), do_route,
+                            lambda l2: l2, leaf2)
+
+    def _wave_block_fn(self):
+        """(bins, leaf2, best, pend_sel, pend_new, acc, grad, hess,
+        act_small) -> (leaf2', acc'): route the pending splits over this
+        block, then fold its active-leaf histograms into the carry."""
+        dd = self.dd_meta
+        kernel = self._kernel_hist
+        hist_mode = self.hist_mode
+        backend = self.backend
+        L = self.L
+
+        def wave_block(bins, leaf2, best, pend_sel, pend_new, acc,
+                       grad, hess, act_small):
+            data = dd._replace(bins=bins)
+            leaf2 = self._route(data, leaf2, best, pend_sel, pend_new)
+            if kernel:
+                hist_fn = make_hist_fn(data, grad, hess, L,
+                                       backend=backend,
+                                       hist_mode=hist_mode)
+                acc = acc + hist_fn(leaf2[1], act_small)
+            else:
+                acc = self._hist_into(acc, data.bins, grad, hess,
+                                      leaf2[1], act_small)
+            return leaf2, acc
+        return self._jit("wave_block", wave_block)
+
+    def _final_route_fn(self):
+        dd = self.dd_meta
+
+        def final_route(bins, leaf2, best, pend_sel, pend_new):
+            return self._route(dd._replace(bins=bins), leaf2, best,
+                               pend_sel, pend_new)
+        return self._jit("final_route", final_route)
+
+    def _init_state_fn(self):
+        """Chunk-sum-fed analog of ``learner.serial._init_state``: the
+        root statistics arrive as the assembled ``[3, m]`` chunk-sum
+        vector (folded over blocks on host) and reduce through the
+        same fixed pairwise tree the resident path uses."""
+        growth = self.growth
+        L = self.L
+        dd = self.dd_meta
+        A0 = self.A_tail
+        Bh = self.Bh
+        B = bin_stride(dd.max_bins)
+
+        def init(cs):
+            sum_g, sum_h, cnt = reduce_chunk_sums(cs)
+            root_out = _leaf_output(sum_g, sum_h, growth.split.lambda_l1,
+                                    growth.split.lambda_l2)
+            Lm = max(L - 1, 1)
+            tree = BuiltTree(
+                feature=jnp.zeros(Lm, jnp.int32),
+                threshold_bin=jnp.zeros(Lm, jnp.int32),
+                default_left=jnp.zeros(Lm, bool),
+                is_categorical=jnp.zeros(Lm, bool),
+                cat_mask=jnp.zeros((Lm, B), bool),
+                left_child=jnp.full(Lm, -1, jnp.int32),
+                right_child=jnp.full(Lm, -1, jnp.int32),
+                gain=jnp.zeros(Lm, jnp.float32),
+                internal_value=jnp.zeros(Lm, jnp.float32),
+                internal_count=jnp.zeros(Lm, jnp.int32),
+                leaf_value=jnp.zeros(L, jnp.float32),
+                leaf_count=jnp.zeros(L, jnp.int32),
+                leaf_depth=jnp.zeros(L, jnp.int32),
+                num_leaves=jnp.asarray(1, jnp.int32),
+                row_leaf=jnp.zeros(0, jnp.int32),
+                row_value=jnp.zeros(0, jnp.float32))
+            return _WaveState(
+                leaf2=jnp.zeros((2, 1), jnp.int32),   # lives per block
+                nl=jnp.asarray(1, jnp.int32), done=jnp.asarray(False),
+                leaf_sum_grad=jnp.zeros(L).at[0].set(sum_g),
+                leaf_sum_hess=jnp.zeros(L).at[0].set(sum_h),
+                leaf_count=jnp.zeros(L).at[0].set(cnt),
+                leaf_depth=jnp.zeros(L, jnp.int32),
+                leaf_value=jnp.zeros(L, jnp.float32).at[0].set(root_out),
+                leaf_parent=jnp.full(L, -1, jnp.int32),
+                leaf_is_left=jnp.zeros(L, bool),
+                hist_state=jnp.zeros((L, dd.num_groups, Bh, 3),
+                                     jnp.float32),
+                best=_empty_best(L, B),
+                pend_sel=jnp.zeros(L, bool),
+                pend_new=jnp.zeros(L, jnp.int32),
+                act_small=jnp.full(A0, -1, jnp.int32).at[0].set(0),
+                act_parent=jnp.full(A0, -1, jnp.int32),
+                act_sibling=jnp.full(A0, -1, jnp.int32),
+                tree=tree)
+        return self._jit("init_state", init)
+
+    def _wave_scan_fn(self):
+        """(state, new_h, fmask) -> (hist_state, ids, res): sibling
+        subtraction + split rescan on the folded accumulator — the
+        same program grouping as the phase driver's ``scan_jit``
+        (``rescan_changed``), which is pinned bitwise against the
+        fused build."""
+        dd = self.dd_meta
+        growth = self.growth
+
+        def wave_scan(s, new_h, fmask):
+            L = s.hist_state.shape[0]
+            hist_state, ids, grid = apply_hist_wave(
+                s.hist_state, new_h, s.act_small, s.act_parent,
+                s.act_sibling, L)
+            return scan_grid(dd, growth, fmask, hist_state, ids, grid,
+                             s.leaf_sum_grad, s.leaf_sum_hess,
+                             s.leaf_count)
+        return self._jit("wave_scan", wave_scan)
+
+    def _wave_apply_fn(self):
+        """Wave bookkeeping (``_apply_wave``) as its own program —
+        the phase driver's ``update_jit`` grouping."""
+        growth = self.growth
+        A_tail = self.A_tail
+        wave_cap = (growth.wave_size if growth.wave_size > 0
+                    else growth.num_leaves)
+
+        def wave_apply(s, hist_state, ids, res):
+            return _apply_wave(s, s.leaf2, hist_state, ids, res,
+                               A_tail, growth, wave_cap)
+        return self._jit("wave_apply", wave_apply)
+
+    def _root_cs_fn(self):
+        def root_cs(grad, hess, mask):
+            return root_chunk_sums(grad, hess, mask)
+        return self._jit("root_cs", root_cs)
+
+    def _score_update_fn(self):
+        def update(scores_b, leaf_value, nl, row_leaf, lr, k):
+            # the fenced body's update shape: stump-masked leaf values,
+            # scale-then-gather — contraction-proof, so this standalone
+            # program rounds like the in-memory fused body
+            lv = jnp.where(nl > 1, leaf_value, jnp.zeros_like(leaf_value))
+            lv_s = lr * lv
+            return scores_b.at[:, k].add(lv_s[row_leaf])
+        return self._jit("score_update", update, static_argnames=("k",))
+
+    def _combine_fn(self, nparts: int):
+        def combine(parts):
+            # shard partials combine in device order — the elementwise
+            # adds the wave psum lowers to on a D-shard mesh
+            out = parts[0]
+            for p in parts[1:]:
+                out = out + p
+            return out
+        return self._jit(f"combine{nparts}", combine)
+
+    # -- block geometry ---------------------------------------------------
+    def _blocks(self) -> List[Tuple[int, int, int, int]]:
+        """-> [(shard, start, stop, valid_rows)]: blocks subdivide each
+        shard's row range (never straddling a shard boundary; padded to
+        the uniform R on upload so one compiled program serves all)."""
+        out = []
+        for s, (lo, hi) in enumerate(self.ranges):
+            hi = min(hi, self.n)
+            pos = lo
+            while pos < hi:
+                stop = min(pos + self.R, hi)
+                out.append((s, pos, stop, stop - pos))
+                pos = stop
+        return out
+
+    def _pad_block(self, arr: Optional[np.ndarray], m: int,
+                   fill=0) -> Optional[np.ndarray]:
+        if arr is None:
+            return None
+        if m == self.R:
+            return np.ascontiguousarray(arr)
+        pad = np.full((self.R - m,) + arr.shape[1:], fill, arr.dtype)
+        return np.concatenate([np.ascontiguousarray(arr), pad])
+
+    # -- training ---------------------------------------------------------
+    def train(self, num_iterations: Optional[int] = None) -> GBDT:
+        iters = num_iterations or self.config.num_iterations
+        with obs_span("stream.train", rows=self.n, block=self.R,
+                      shards=self.S):
+            for it in range(iters):
+                if self._train_one_iter(it):
+                    break
+        self.booster.scores = self.scores     # host state IS the digest
+        self.booster.trim_trailing_stumps()
+        return self.booster
+
+    def _train_one_iter(self, it: int) -> bool:
+        c = self.config
+        K = self.K
+        grad_fn = self._grad_fn()
+        blocks = self._blocks()
+        n = self.n
+        # gradients per block, stored host-side for the tree's waves
+        G = np.empty((n, K), np.float32)
+        H = np.empty((n, K), np.float32)
+        with obs_span("stream.gradients", it=it):
+            for _, start, stop, m in blocks:
+                _, label, weight = self.src.read_rows(start, stop)
+                sc = self._pad_block(self.scores[start:stop], m)
+                lb = self._pad_block(
+                    np.asarray(label, np.float32), m)
+                wb = self._pad_block(
+                    np.asarray(weight, np.float32) if weight is not None
+                    else None, m)
+                g, h = grad_fn(jnp.asarray(sc), jnp.asarray(lb),
+                               jnp.asarray(wb) if wb is not None else None)
+                G[start:stop] = np.asarray(g)[:m]
+                H[start:stop] = np.asarray(h)[:m]
+
+        F = self.src.num_features
+        ff_on = c.feature_fraction < 1.0
+        kf = max(1, int(c.feature_fraction * F))
+        stumps = 0
+        for k in range(K):
+            # None (not all-ones) when feature_fraction is off — the
+            # resident build traces the no-mask program shape
+            fmask = (_device_feature_mask(c.feature_fraction_seed,
+                                          it * K + k, F, kf)
+                     if ff_on else None)
+            nl = self._build_streamed_tree(it, k, G[:, k], H[:, k], fmask)
+            if nl <= 1:
+                stumps += 1
+        self.booster.iter += 1
+        if stumps == K:
+            # mirror the in-memory stop: drop the all-stump iteration
+            self.booster._pending = self.booster._pending[:-K]
+            self.booster.iter -= 1
+            log_warning("stopped streamed training: no more leaves meet "
+                        f"the split requirements (iteration {it + 1})")
+            return True
+        return False
+
+    def _block_arrays(self, start: int, stop: int, m: int,
+                      grad: np.ndarray, hess: np.ndarray):
+        """One block's device uploads for a wave pass: bins + padded
+        grad/hess.  Re-uploaded per wave — HBM holds ONE block (plus
+        the XLA double-buffer in flight), never the dataset."""
+        bins, _, _ = self.src.read_rows(start, stop)
+        bins_d = jnp.asarray(self._pad_block(np.asarray(bins), m))
+        gb = self._pad_block(grad[start:stop], m)
+        hb = self._pad_block(hess[start:stop], m)
+        return bins_d, jnp.asarray(gb), jnp.asarray(hb)
+
+    def _build_streamed_tree(self, it: int, k: int, grad: np.ndarray,
+                             hess: np.ndarray, fmask) -> int:
+        L = self.L
+        blocks = self._blocks()
+        wave_block = self._wave_block_fn()
+        wave_scan = self._wave_scan_fn()
+        wave_apply = self._wave_apply_fn()
+        root_cs = self._root_cs_fn()
+        combine = self._combine_fn(self.S)
+        init_state = self._init_state_fn()
+        update = self._score_update_fn()
+        A = self.A_tail
+
+        # leaf2 carries on host between waves (the streaming traffic);
+        # root statistics fold per shard, reduce through the fixed
+        # pairwise tree, and shard scalars combine in device order
+        leaf2_host: List[np.ndarray] = []
+        shard_cs = [[] for _ in range(self.S)]
+        for (s, start, stop, m) in blocks:
+            mask = np.zeros(self.R, bool)
+            mask[:m] = True
+            gb = self._pad_block(grad[start:stop], m)
+            hb = self._pad_block(hess[start:stop], m)
+            cs = np.asarray(root_cs(jnp.asarray(gb), jnp.asarray(hb),
+                                    jnp.asarray(mask)))
+            shard_cs[s].append(cs)
+            l2 = np.full((2, self.R), -1, np.int32)
+            l2[0, :] = 0
+            l2[1, :m] = 0
+            leaf2_host.append(l2)
+
+        # in-memory chunk grids: serial = ceil(n/C); data-parallel =
+        # ceil(per/C) per shard (mesh padding rows are zero chunks)
+        if self.S == 1:
+            m_chunks = -(-self.n // STREAM_CHUNK)
+            cs_all = np.concatenate(shard_cs[0], axis=1)[:, :m_chunks]
+            state = init_state(jnp.asarray(cs_all))
+        else:
+            m_chunks = -(-self.per // STREAM_CHUNK)
+            parts = []
+            for cs_list in shard_cs:
+                cs = (np.concatenate(cs_list, axis=1) if cs_list
+                      else np.zeros((3, 0), np.float32))
+                if cs.shape[1] < m_chunks:   # trailing mesh-pad chunks
+                    cs = np.concatenate(
+                        [cs, np.zeros((3, m_chunks - cs.shape[1]),
+                                      np.float32)], axis=1)
+                parts.append(jnp.stack(reduce_chunk_sums(
+                    jnp.asarray(cs[:, :m_chunks]))))
+            tot = combine(parts)
+            state = init_state(tot[:, None])   # [3, 1]: identity reduce
+
+        while True:
+            if bool(state.done) or int(state.nl) >= L:
+                break
+            accs = [jnp.zeros((A, self.dd_meta.num_groups, self.Bh, 3),
+                              jnp.float32) for _ in range(self.S)]
+            for bi, (s, start, stop, m) in enumerate(blocks):
+                bins_d, gd, hd = self._block_arrays(start, stop, m,
+                                                    grad, hess)
+                l2, acc = wave_block(
+                    bins_d, jnp.asarray(leaf2_host[bi]), state.best,
+                    state.pend_sel, state.pend_new, accs[s], gd, hd,
+                    state.act_small)
+                leaf2_host[bi] = np.asarray(l2)
+                accs[s] = acc
+            new_h = accs[0] if self.S == 1 else combine(accs)
+            hist_state, ids, res = wave_scan(state, new_h, fmask)
+            state = wave_apply(state, hist_state, ids, res)
+            counter_add("stream.waves")
+
+        # final route + per-block score updates
+        final_route = self._final_route_fn()
+        lr = jnp.float32(self.booster.shrinkage_rate)
+        nl = state.nl
+        for bi, (s, start, stop, m) in enumerate(blocks):
+            bins, _, _ = self.src.read_rows(start, stop)
+            bins_d = jnp.asarray(self._pad_block(np.asarray(bins), m))
+            l2 = final_route(bins_d, jnp.asarray(leaf2_host[bi]),
+                             state.best, state.pend_sel, state.pend_new)
+            row_leaf = l2[0]
+            sc = self._pad_block(self.scores[start:stop], m)
+            out = update(jnp.asarray(sc), state.leaf_value, nl,
+                         row_leaf, lr, k=k)
+            self.scores[start:stop] = np.asarray(out)[:m]
+
+        # host tree (reuses the GBDT conversion machinery via _pending)
+        lv_final = jnp.where(nl > 1, state.leaf_value,
+                             jnp.zeros_like(state.leaf_value))
+        bt = state.tree._replace(
+            leaf_value=lv_final,
+            leaf_count=state.leaf_count.astype(jnp.int32),
+            leaf_depth=state.leaf_depth,
+            num_leaves=nl,
+            row_leaf=jnp.zeros(0, jnp.int32),
+            row_value=jnp.zeros(0, jnp.float32))
+        bias = (self.booster.init_score_value
+                if (self.booster._num_models() < self.K
+                    and abs(self.booster.init_score_value) > 1e-15)
+                else 0.0)
+        self.booster._pending.append(
+            (bt, self.booster.shrinkage_rate, bias, 1))
+        counter_add("stream.trees")
+        return int(nl)
+
+
+def train_streaming(params, source, num_boost_round: Optional[int] = None,
+                    cache_dir: Optional[str] = None,
+                    block_rows: int = 0) -> GBDT:
+    """Train out-of-core: ``source`` is a ShardStore, a list of data
+    files (ingested into ``cache_dir`` first), or a resident
+    BinnedDataset (streamed from RAM — the source-independence anchor).
+    Returns a regular GBDT booster (save/predict/digest)."""
+    from ..config import canonicalize_params
+    from ..io.outofcore import default_cache_dir, ingest
+    config = Config.from_params(canonicalize_params(dict(params)))
+    config.check()
+    if isinstance(source, (list, tuple)):
+        cdir = cache_dir or default_cache_dir(list(source))
+        source = ingest(list(source), config, cdir)
+    trainer = StreamTrainer(config, source, block_rows=block_rows)
+    return trainer.train(num_boost_round)

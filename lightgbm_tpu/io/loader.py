@@ -197,6 +197,36 @@ def _column_plan(ncol: int, config: Config, header_names):
     return label_idx, weight_idx, query_idx, keep, names, cat_cols
 
 
+def raw_data_row_count(path: str, skip: int) -> int:
+    """Data row count via a raw byte scan (no parsing; bounded reads).
+    Blank lines are NOT rows — the chunk parsers skip them, and the
+    count must match or the global sample-index draw shifts (shared by
+    the two-round loader and the out-of-core shard ingest,
+    ``io/outofcore.py``, whose multi-file sample discipline needs every
+    file's exact row count before any file is parsed)."""
+    n = 0
+    pending = False      # current line has non-whitespace content
+    with open(path, "rb") as f:
+        while True:
+            chunk = f.read(4 << 20)
+            if not chunk:
+                break
+            filtered = chunk.translate(None, delete=b"\r \t")
+            arr = np.frombuffer(filtered, np.uint8)
+            nls = np.flatnonzero(arr == 10)
+            if len(nls):
+                gaps = np.diff(np.concatenate([[-1], nls])) > 1
+                if nls[0] == 0 and pending:
+                    gaps[0] = True   # line continued from prior chunk
+                n += int(gaps.sum())
+                pending = bool(len(arr) - 1 - nls[-1] > 0)
+            else:
+                pending = pending or len(arr) > 0
+    if pending:
+        n += 1                      # unterminated final line
+    return n - skip
+
+
 def load_file_two_round(path: str, config: Config, rank: int = 0,
                         num_machines: int = 1,
                         allgather=None) -> "BinnedDataset":
@@ -250,30 +280,10 @@ def load_file_two_round(path: str, config: Config, rank: int = 0,
             with open(path) as f:
                 header_names = f.readline().rstrip("\n").split(sep)
 
-        # round 0: data row count via a raw scan (no parsing; bounded
-        # reads).  Blank lines are NOT rows — the chunk parser skips
-        # them, and the count must match or the sample-index draw shifts.
-        n = 0
-        pending = False      # current line has non-whitespace content
-        with open(path, "rb") as f:
-            while True:
-                chunk = f.read(4 << 20)
-                if not chunk:
-                    break
-                filtered = chunk.translate(None, delete=b"\r \t")
-                arr = np.frombuffer(filtered, np.uint8)
-                nls = np.flatnonzero(arr == 10)
-                if len(nls):
-                    gaps = np.diff(np.concatenate([[-1], nls])) > 1
-                    if nls[0] == 0 and pending:
-                        gaps[0] = True   # line continued from prior chunk
-                    n += int(gaps.sum())
-                    pending = bool(len(arr) - 1 - nls[-1] > 0)
-                else:
-                    pending = pending or len(arr) > 0
-        if pending:
-            n += 1                      # unterminated final line
-        n -= skip
+        # round 0: data row count via a raw scan (extracted to
+        # raw_data_row_count so the out-of-core shard ingest shares the
+        # exact same blank-line discipline)
+        n = raw_data_row_count(path, skip)
         ncol = None
         chunk_bytes = 4 << 20           # bounded: ~4 MB text per chunk
 

@@ -1,0 +1,636 @@
+"""Out-of-core ingest — the binned shard store (ROADMAP item 4).
+
+The fork's signature delta over upstream LightGBM is per-rank sharded
+data fetch from a distributed FS (``DownloadData``, reference
+`application.cpp:168-237`), and the reference's ``.bin`` dataset cache
+is what makes training beyond RAM practical.  This module is both,
+done on our seams:
+
+* **per-rank file-list sharding** over the ``utils/file_io.py`` scheme
+  registry — rank ``r`` of ``S`` owns ``sources[r::S]``, each shard
+  file ``localize()``-d (remote schemes download to a temp path) under
+  the shared retry policy with the ``ingest.shard_fetch`` fault seam;
+* **multi-file sampled bin finding** — the two-round loader's
+  global-sample-index discipline (`io/loader.py load_file_two_round`)
+  extended to a file LIST: row counts come from the same raw scan
+  (``raw_data_row_count``), the sample is drawn over the concatenated
+  global row space with the same ``data_random_seed`` RNG, and the
+  mappers come from the same ``find_mappers_from_sample`` — so they
+  are byte-identical to the in-memory path loading the concatenation
+  (pinned by tests/test_outofcore.py);
+* **an mmap-able binned shard cache** — the reference ``.bin`` analog:
+  chunked parse → binned uint8/int32 row blocks appended to
+  ``shard-<k>.bins`` (written tmp+rename, with the
+  ``ingest.cache_write`` fault seam between chunks), a per-shard JSON
+  sidecar published only after the blob, and a sha256'd ``manifest``
+  written LAST via ``atomic_write`` — so a SIGKILL at any instant
+  leaves either a valid complete cache or an obviously-incomplete one
+  whose finished shards are reused on the next run (resumable
+  mid-ingest) and whose torn shards are re-ingested, never trained on.
+
+The cache is keyed on **source bytes + BinMapper-relevant config**
+(``cache_key``): a changed source file or a changed binning knob
+produces a different key, and ``load_store`` refuses a stale cache
+instead of silently training on the wrong bins.
+
+Training against the store is the streaming block trainer
+(``boosting/streaming.py``): rows stay in this mmap cache and stream
+through the device block-by-block (``LGBM_TPU_STREAM_ROWS``).
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..config import Config
+from ..utils.faults import fault_point
+from ..utils.file_io import atomic_write, localize, release
+from ..utils.log import log_info, log_warning
+from ..utils.retry import retry_call
+from .dataset import BinnedDataset, Metadata, find_mappers_from_sample
+
+STORE_VERSION = 1
+MANIFEST = "manifest.json"
+
+# binning-relevant config knobs the cache key covers: any change here
+# changes the mappers, so it must invalidate the cache
+_KEY_KNOBS = ("max_bin", "min_data_in_bin", "bin_construct_sample_cnt",
+              "data_random_seed", "use_missing", "zero_as_missing",
+              "categorical_column", "label_column", "weight_column",
+              "ignore_column", "has_header", "two_round_chunk_bytes")
+
+
+def _sha256_bytes(*parts: bytes) -> str:
+    h = hashlib.sha256()
+    for p in parts:
+        h.update(p)
+    return h.hexdigest()
+
+
+def mapper_digest(mappers) -> str:
+    """Canonical sha256 over the BinMapper set — the bin-boundary
+    identity the manifest records and ``load_store`` re-checks, so a
+    cache binned under different boundaries can never silently train."""
+    payload = json.dumps([m.to_dict() for m in mappers], sort_keys=True,
+                         default=float).encode()
+    return _sha256_bytes(payload)
+
+
+def _config_key(config: Config) -> Dict:
+    return {k: getattr(config, k, None) for k in _KEY_KNOBS}
+
+
+def _source_fingerprint(path: str) -> Dict:
+    """Cheap per-source identity: name + byte size (a full content
+    sha256 is recorded per SHARD during ingest, where the bytes stream
+    through anyway)."""
+    return {"path": os.path.basename(path),
+            "bytes": os.path.getsize(path) if os.path.exists(path) else -1}
+
+
+def cache_key(sources: List[str], config: Config) -> str:
+    """The store identity: source fingerprints + binning knobs."""
+    payload = json.dumps({
+        "version": STORE_VERSION,
+        "sources": [_source_fingerprint(localize_probe(s)) for s in sources],
+        "config": _config_key(config),
+    }, sort_keys=True, default=str).encode()
+    return _sha256_bytes(payload)
+
+
+def localize_probe(path: str) -> str:
+    """Local path for fingerprinting: identity for local files; remote
+    schemes fingerprint by path only (size -1), so their staleness is
+    caught by the per-shard source sha recorded at ingest."""
+    return path if "://" not in path else path
+
+
+def shard_sources(sources: List[str], rank: int, num_ranks: int
+                  ) -> List[str]:
+    """Per-rank file-list sharding (the ``DownloadData`` ownership rule:
+    rank ``r`` fetches and ingests ``sources[r::S]``)."""
+    return list(sources)[rank::max(1, num_ranks)]
+
+
+# ---------------------------------------------------------------------------
+# multi-file chunk streaming (shared parse discipline with io/loader.py)
+# ---------------------------------------------------------------------------
+def _file_plan(path: str, config: Config):
+    """-> (fmt, sep, skip, header_names, chunk_stream_fn, n_rows)."""
+    from .loader import detect_format, raw_data_row_count
+    from .. import native
+    fmt = detect_format(path, config.has_header)
+    skip = 1 if config.has_header else 0
+    header_names = None
+    chunk_bytes = 4 << 20
+    if fmt == "libsvm":
+        scanned = native.scan_libsvm(path, skip) if native.available() else None
+        if scanned is None:
+            raise ValueError(
+                "out-of-core ingest needs the native parser for libsvm "
+                f"sources ({path!r})")
+        n, fcols = scanned
+
+        def stream(fc=fcols):
+            return native.parse_libsvm_chunks(path, skip, fc,
+                                              chunk_bytes=chunk_bytes)
+        return fmt, " ", skip, None, stream, int(n), int(fcols) + 1
+    sep = {"csv": ",", "tsv": "\t"}[fmt]
+    if config.has_header:
+        with open(path) as f:
+            header_names = f.readline().rstrip("\n").split(sep)
+    n = raw_data_row_count(path, skip)
+
+    def stream():
+        from .. import native as nat
+        if nat.available():
+            yield from nat.parse_delimited_chunks(path, sep, skip,
+                                                  chunk_bytes=chunk_bytes)
+            return
+        # pure-python fallback: bounded line batches (tier-1 must not
+        # depend on the native .so being buildable)
+        import io as _io
+        with open(path) as f:
+            for _ in range(skip):
+                f.readline()
+            while True:
+                lines = f.readlines(chunk_bytes)
+                if not lines:
+                    break
+                body = "".join(ln for ln in lines if ln.strip())
+                if not body:
+                    continue
+                arr = np.genfromtxt(_io.StringIO(body), delimiter=sep,
+                                    dtype=np.float64)
+                yield arr.reshape(-1, arr.shape[-1]) if arr.ndim else \
+                    arr.reshape(1, -1)
+    return fmt, sep, skip, header_names, stream, int(n), None
+
+
+def find_mappers_multi(files: List[str], config: Config
+                       ) -> Tuple[list, List[int], List[str], int,
+                                  List[int], tuple]:
+    """Round 1 of the two-round scheme over a file LIST: draw the bin-
+    finding sample over the CONCATENATED global row space with the same
+    RNG draw as the in-memory path, stream every file keeping only
+    sampled rows, and find mappers from the sample.
+
+    -> (mappers, used_features, feature_names, num_total_features,
+        per_file_rows, column_plan)
+
+    Byte-identity contract: the mappers equal ``BinnedDataset.from_raw``
+    over the concatenation of the files (same ``data_random_seed``
+    draw over the same global indices — tests/test_outofcore.py pins a
+    3-file list against the single concatenated file)."""
+    from .loader import _column_plan
+    plans = [_file_plan(p, config) for p in files]
+    rows = [pl[5] for pl in plans]
+    n = int(sum(rows))
+    if n <= 0:
+        raise ValueError(f"no data rows in shard list {files!r}")
+    sample_cnt = min(n, config.bin_construct_sample_cnt)
+    rng = np.random.RandomState(config.data_random_seed)
+    sample_gidx = (np.arange(n) if sample_cnt >= n
+                   else np.sort(rng.choice(n, sample_cnt, replace=False)))
+
+    sample_rows = []
+    plan = None
+    base = 0
+    for (fmt, sep, skip, header_names, stream, n_f, ncol), path in zip(
+            plans, files):
+        seen = 0
+        for chunk in stream():
+            if plan is None:
+                plan = _column_plan(chunk.shape[1], config, header_names)
+            lo = np.searchsorted(sample_gidx, base + seen)
+            hi = np.searchsorted(sample_gidx, base + seen + len(chunk))
+            if hi > lo:
+                sample_rows.append(
+                    np.array(chunk[sample_gidx[lo:hi] - base - seen]))
+            seen += len(chunk)
+        if seen != n_f:
+            raise ValueError(
+                f"chunked parse of {path!r} saw {seen} rows, raw scan "
+                f"counted {n_f}")
+        base += n_f
+    label_idx, weight_idx, query_idx, keep, names, cat_cols = plan
+    if query_idx is not None:
+        raise ValueError(
+            "out-of-core ingest does not support ranking group columns "
+            "(streamed row blocks would split queries; see README "
+            "\"Out-of-core training\")")
+    sample = np.concatenate(sample_rows)[:, keep]
+    mappers = find_mappers_from_sample(sample, config, set(cat_cols))
+    used = [f for f in range(len(keep)) if not mappers[f].is_trivial]
+    return mappers, used, names, len(keep), rows, plan
+
+
+# ---------------------------------------------------------------------------
+# the shard store
+# ---------------------------------------------------------------------------
+class ShardStore:
+    """An opened (complete, key-validated) binned shard cache.
+
+    Row blocks are served as numpy views of the per-shard memmaps —
+    host RSS holds only the touched (evictable) pages, never the whole
+    binned matrix — which is what lets the streaming trainer's memory
+    scale with ``LGBM_TPU_STREAM_ROWS`` instead of dataset rows."""
+
+    def __init__(self, cache_dir: str, manifest: Dict):
+        self.cache_dir = cache_dir
+        self.manifest = manifest
+        from .binning import BinMapper
+        self.mappers = [BinMapper.from_dict(d) for d in manifest["mappers"]]
+        self.used_features = list(manifest["used_features"])
+        self.feature_names = list(manifest["feature_names"])
+        self.num_total_features = int(manifest["num_total_features"])
+        self.dtype = np.dtype(manifest["dtype"])
+        self.feature_info = BinnedDataset._build_feature_info(
+            [self.mappers[f] for f in self.used_features])
+        self._shards = manifest["shards"]
+        self._rows = [int(s["rows"]) for s in self._shards]
+        self._offsets = np.concatenate(
+            [[0], np.cumsum(self._rows)]).astype(np.int64)
+        self.n = int(self._offsets[-1])
+        self._bins: List[Optional[np.memmap]] = [None] * len(self._shards)
+        self._label: List[Optional[np.memmap]] = [None] * len(self._shards)
+        self._weight: List[Optional[np.memmap]] = [None] * len(self._shards)
+        self.has_weight = any(s.get("has_weight") for s in self._shards)
+
+    @property
+    def num_features(self) -> int:
+        return len(self.used_features)
+
+    def _mm(self, cache, k: int, suffix: str, shape, dtype):
+        if cache[k] is None:
+            if shape[0] == 0:
+                cache[k] = np.zeros(shape, dtype)
+            else:
+                path = os.path.join(self.cache_dir,
+                                    self._shards[k]["name"] + suffix)
+                cache[k] = np.memmap(path, dtype=dtype, mode="r",
+                                     shape=shape)
+        return cache[k]
+
+    def _shard_bins(self, k: int) -> np.ndarray:
+        return self._mm(self._bins, k, ".bins",
+                        (self._rows[k], self.num_features), self.dtype)
+
+    def _shard_label(self, k: int) -> np.ndarray:
+        return self._mm(self._label, k, ".label", (self._rows[k],),
+                        np.float32)
+
+    def _shard_weight(self, k: int) -> Optional[np.ndarray]:
+        if not self._shards[k].get("has_weight"):
+            return None
+        return self._mm(self._weight, k, ".weight", (self._rows[k],),
+                        np.float32)
+
+    def _gather(self, start: int, stop: int, per_shard) -> np.ndarray:
+        """Concatenate ``[start, stop)`` of the global row space from
+        per-shard arrays (views when the range stays inside one shard)."""
+        lo = int(np.searchsorted(self._offsets, start, side="right") - 1)
+        parts = []
+        pos = start
+        k = lo
+        while pos < stop:
+            s0, s1 = self._offsets[k], self._offsets[k + 1]
+            a, b = pos - s0, min(stop, s1) - s0
+            parts.append(per_shard(k)[a:b])
+            pos += b - a
+            k += 1
+        return parts[0] if len(parts) == 1 else np.concatenate(parts)
+
+    def read_rows(self, start: int, stop: int
+                  ) -> Tuple[np.ndarray, np.ndarray, Optional[np.ndarray]]:
+        """-> (bins [m, G], label [m], weight [m] or None)."""
+        bins = self._gather(start, stop, self._shard_bins)
+        label = self._gather(start, stop, self._shard_label)
+        weight = (self._gather(start, stop, self._shard_weight)
+                  if self.has_weight else None)
+        return bins, label, weight
+
+    def labels_array(self) -> np.ndarray:
+        """The full label vector (concatenated memmap views) — used for
+        the host-side boost-from-average statistic at fittable sizes."""
+        return self._gather(0, self.n, self._shard_label)
+
+    def weights_array(self) -> Optional[np.ndarray]:
+        if not self.has_weight:
+            return None
+        return self._gather(0, self.n, self._shard_weight)
+
+    def to_binned_dataset(self, config: Config) -> BinnedDataset:
+        """Materialize a RESIDENT BinnedDataset (the fittable-size
+        parity anchor; obviously not for out-of-core shapes)."""
+        packed = np.array(self._gather(0, self.n, self._shard_bins))
+        md = Metadata()
+        md.set_field("label", np.array(self.labels_array()))
+        w = self.weights_array()
+        if w is not None:
+            md.set_field("weight", np.array(w))
+        ds = BinnedDataset()
+        ds.config = config
+        ds.num_total_features = self.num_total_features
+        ds.feature_names = list(self.feature_names)
+        ds.mappers = self.mappers
+        ds.used_features = list(self.used_features)
+        cols = [packed[:, j] for j in range(self.num_features)]
+        return BinnedDataset._finish_from_mappers(
+            ds, np.zeros((self.n, 0)), config, md, self.n,
+            self.num_total_features, cols=cols, packed=packed,
+            allow_bundle=False)
+
+
+# ---------------------------------------------------------------------------
+# ingest
+# ---------------------------------------------------------------------------
+def _shard_paths(cache_dir: str, k: int) -> Dict[str, str]:
+    name = f"shard-{k:04d}"
+    base = os.path.join(cache_dir, name)
+    return {"name": name, "bins": base + ".bins", "label": base + ".label",
+            "weight": base + ".weight", "sidecar": base + ".json"}
+
+
+def _sidecar_valid(cache_dir: str, k: int, key: str, source: Dict,
+                   itemsize_x_cols: int) -> Optional[Dict]:
+    """A shard is reusable iff its sidecar parses, matches this store
+    key + source fingerprint, and the published blob sizes agree with
+    the recorded row count — a torn or foreign blob is re-ingested."""
+    p = _shard_paths(cache_dir, k)
+    try:
+        with open(p["sidecar"]) as f:
+            sc = json.load(f)
+    except (OSError, ValueError):
+        return None
+    if sc.get("key") != key or sc.get("source") != source:
+        return None
+    rows = int(sc.get("rows", -1))
+    if rows < 0:
+        return None
+    want = rows * itemsize_x_cols
+    try:
+        if rows and os.path.getsize(p["bins"]) != want:
+            return None
+        if rows and os.path.getsize(p["label"]) != rows * 4:
+            return None
+        if sc.get("has_weight") and rows and \
+                os.path.getsize(p["weight"]) != rows * 4:
+            return None
+    except OSError:
+        return None
+    return sc
+
+
+def _ingest_one_shard(k: int, path: str, config: Config, cache_dir: str,
+                      mappers, used, plan, key: str, dtype) -> Dict:
+    """Parse one shard file chunk-by-chunk into the cache.  Crash-safe:
+    blobs build under ``.tmp`` names, are published with ``os.replace``,
+    and the sidecar (the validity marker) goes last."""
+    from ..obs import counter_add, span
+    label_idx, weight_idx, query_idx, keep, names, cat_cols = plan
+    p = _shard_paths(cache_dir, k)
+
+    def _fetch(src):
+        # the DownloadData analog: a flaky remote FS read is a
+        # transient, not a lost ingest
+        fault_point("ingest.shard_fetch")
+        return localize(src)
+
+    local = retry_call(_fetch, path, what="ingest.shard_fetch")
+    fmt, sep, skip, header_names, stream, n_f, _ = _file_plan(local, config)
+    source = _source_fingerprint(local)
+    source["path"] = os.path.basename(path)
+
+    with span("ingest.shard", shard=k, rows=n_f):
+        sha = hashlib.sha256()
+        rows = 0
+        has_weight = weight_idx is not None
+        fb = open(p["bins"] + ".tmp", "wb")
+        fl = open(p["label"] + ".tmp", "wb")
+        fw = open(p["weight"] + ".tmp", "wb") if has_weight else None
+        try:
+            for chunk in stream():
+                binned = np.empty((len(chunk), len(used)), dtype)
+                for j, f in enumerate(used):
+                    binned[:, j] = mappers[f].value_to_bin(
+                        chunk[:, keep[f]])
+                payload = np.ascontiguousarray(binned).tobytes()
+                sha.update(payload)
+                fb.write(payload)
+                fl.write(np.ascontiguousarray(
+                    chunk[:, label_idx].astype(np.float32)).tobytes())
+                if fw is not None:
+                    fw.write(np.ascontiguousarray(
+                        chunk[:, weight_idx].astype(np.float32)).tobytes())
+                rows += len(chunk)
+                # mid-shard crash seam: a fault (or SIGKILL) here
+                # leaves only .tmp garbage — the shard is re-ingested
+                fault_point("ingest.cache_write")
+            for f in (fb, fl) + ((fw,) if fw else ()):
+                f.flush()
+                os.fsync(f.fileno())
+        finally:
+            fb.close()
+            fl.close()
+            if fw is not None:
+                fw.close()
+        if rows != n_f:
+            raise ValueError(
+                f"shard {path!r}: chunked parse yielded {rows} rows, "
+                f"raw scan counted {n_f}")
+        os.replace(p["bins"] + ".tmp", p["bins"])
+        os.replace(p["label"] + ".tmp", p["label"])
+        if has_weight:
+            os.replace(p["weight"] + ".tmp", p["weight"])
+        sc = {"key": key, "rows": rows, "sha256": sha.hexdigest(),
+              "source": source, "has_weight": has_weight, "name": p["name"]}
+        # sidecar LAST: its existence is the shard's validity marker
+        atomic_write(p["sidecar"], json.dumps(sc, indent=1))
+    counter_add("ingest.shards")
+    counter_add("ingest.rows", rows)
+    if local != path:
+        release(local)
+    return sc
+
+
+def load_store(cache_dir: str, sources: List[str], config: Config,
+               rank: int = 0, num_ranks: int = 1) -> Optional[ShardStore]:
+    """Open an existing cache iff its manifest matches this (sources,
+    config) key — a stale cache (changed bytes, changed binning knobs,
+    hence a different mapper set) is REJECTED, never silently trained."""
+    path = os.path.join(cache_dir, MANIFEST)
+    try:
+        with open(path) as f:
+            manifest = json.load(f)
+    except (OSError, ValueError):
+        return None
+    files = shard_sources(sources, rank, num_ranks)
+    if manifest.get("key") != cache_key(files, config):
+        log_warning(f"shard cache at {cache_dir!r} is stale (key "
+                    "mismatch: source bytes or binning config changed); "
+                    "re-ingesting")
+        return None
+    store = ShardStore(cache_dir, manifest)
+    # cheap structural re-validation: every shard blob still matches its
+    # sidecar size (a truncated blob must never be trained on)
+    itemsize = store.dtype.itemsize * store.num_features
+    for k in range(len(files)):
+        if _sidecar_valid(cache_dir, k, manifest["key"],
+                          manifest["shards"][k]["source"], itemsize) is None:
+            log_warning(f"shard cache at {cache_dir!r}: shard {k} is "
+                        "torn; re-ingesting")
+            return None
+    return store
+
+
+def ingest(sources: List[str], config: Config, cache_dir: str,
+           rank: int = 0, num_ranks: int = 1) -> ShardStore:
+    """Build (or resume, or cache-hit) the binned shard store for this
+    rank's file-list shard.  Idempotent and SIGKILL-resumable: finished
+    shards (valid sidecars) are reused, torn shards re-ingested, and
+    the manifest is only ever written after every shard is valid."""
+    files = shard_sources(sources, rank, num_ranks)
+    if not files:
+        raise ValueError(f"rank {rank}/{num_ranks} owns no source files")
+    os.makedirs(cache_dir, exist_ok=True)
+    hit = load_store(cache_dir, sources, config, rank, num_ranks)
+    if hit is not None:
+        log_info(f"shard cache hit at {cache_dir!r} "
+                 f"({hit.n} rows, {len(files)} shards)")
+        return hit
+    key = cache_key(files, config)
+
+    from ..obs import span
+    with span("ingest.find_bins", files=len(files)):
+        mappers, used, names, num_total, rows, plan = \
+            find_mappers_multi(files, config)
+    max_nb = max((mappers[f].num_bin for f in used), default=2)
+    dtype = np.dtype(np.uint8 if max_nb <= 256 else np.int32)
+    itemsize = dtype.itemsize * len(used)
+
+    shards = []
+    reused = 0
+    for k, path in enumerate(files):
+        src = _source_fingerprint(path)
+        sc = _sidecar_valid(cache_dir, k, key, src, itemsize)
+        if sc is not None:
+            reused += 1
+        else:
+            # retried as a unit: a transient mid-shard fault (flaky FS,
+            # injected ingest.cache_write) re-ingests THIS shard only
+            sc = retry_call(_ingest_one_shard, k, path, config, cache_dir,
+                            mappers, used, plan, key, dtype,
+                            what="ingest.cache_write")
+        shards.append(sc)
+    if reused:
+        log_info(f"resumed ingest: reused {reused}/{len(files)} "
+                 "already-valid shards")
+
+    manifest = {
+        "version": STORE_VERSION,
+        "key": key,
+        "mapper_digest": mapper_digest(mappers),
+        "mappers": [m.to_dict() for m in mappers],
+        "used_features": list(map(int, used)),
+        "feature_names": list(names),
+        "num_total_features": int(num_total),
+        "dtype": dtype.name,
+        "config": _config_key(config),
+        "shards": shards,
+        "total_rows": int(sum(s["rows"] for s in shards)),
+    }
+    # manifest-last commit: tmp+rename via the same atomic discipline as
+    # snapshots — readers either see a complete store or none at all
+    atomic_write(os.path.join(cache_dir, MANIFEST),
+                 json.dumps(manifest, indent=1))
+    log_info(f"ingested {manifest['total_rows']} rows into "
+             f"{len(shards)} shard(s) at {cache_dir!r}")
+    return ShardStore(cache_dir, manifest)
+
+
+def default_cache_dir(sources: List[str]) -> str:
+    """``LGBM_TPU_STREAM_CACHE`` override, else a ``.lgbm_shards``
+    directory next to the first source."""
+    override = os.environ.get("LGBM_TPU_STREAM_CACHE")
+    if override:
+        return override
+    first = sources[0]
+    base = os.path.dirname(first) if "://" not in first else "."
+    return os.path.join(base or ".", ".lgbm_shards")
+
+
+# synthetic-store writer: the bench's >=100M-row leg writes binned
+# blocks straight into the store format (text parse throughput is
+# covered at toy scale; the 100M leg measures streamed TRAINING)
+def ingest_synthetic(cache_dir: str, rows: int, features: int,
+                     config: Config, seed: int = 0,
+                     shard_rows: int = 1 << 22) -> ShardStore:
+    """Write a synthetic pre-binned store: HIGGS-shaped uniform bins +
+    a separable label, emitted shard-by-shard so peak host memory is
+    one shard.  Shares the manifest/sidecar discipline with
+    :func:`ingest` (same resumability), keyed on (rows, features,
+    seed, max_bin)."""
+    os.makedirs(cache_dir, exist_ok=True)
+    from .binning import BIN_NUMERICAL, BinMapper
+    rng = np.random.RandomState(seed)
+    mappers = []
+    for f in range(features):
+        m = BinMapper()
+        m.find_bin(rng.uniform(size=256), 256, config.max_bin, 1,
+                   bin_type=BIN_NUMERICAL, use_missing=False,
+                   zero_as_missing=False)
+        mappers.append(m)
+    used = list(range(features))
+    key = _sha256_bytes(json.dumps(
+        {"synthetic": [rows, features, seed, int(config.max_bin)]},
+        sort_keys=True).encode())
+    max_nb = max(m.num_bin for m in mappers)
+    dtype = np.dtype(np.uint8 if max_nb <= 256 else np.int32)
+    n_shards = -(-rows // shard_rows)
+    shards = []
+    for k in range(n_shards):
+        m_rows = min(shard_rows, rows - k * shard_rows)
+        src = {"path": f"synthetic-{k}", "bytes": m_rows}
+        sc = _sidecar_valid(cache_dir, k, key, src,
+                            dtype.itemsize * features)
+        if sc is None:
+            p = _shard_paths(cache_dir, k)
+            r = np.random.RandomState(seed + 1 + k)
+            bins = r.randint(0, max(2, max_nb - 1),
+                             size=(m_rows, features)).astype(dtype)
+            label = (bins[:, 0].astype(np.float32)
+                     + 0.5 * bins[:, 1] > 0.75 * (max_nb - 2)
+                     ).astype(np.float32)
+            sha = hashlib.sha256(bins.tobytes())
+            with open(p["bins"] + ".tmp", "wb") as f:
+                f.write(bins.tobytes())
+                f.flush()
+                os.fsync(f.fileno())
+            with open(p["label"] + ".tmp", "wb") as f:
+                f.write(label.tobytes())
+                f.flush()
+                os.fsync(f.fileno())
+            os.replace(p["bins"] + ".tmp", p["bins"])
+            os.replace(p["label"] + ".tmp", p["label"])
+            sc = {"key": key, "rows": int(m_rows), "sha256": sha.hexdigest(),
+                  "source": src, "has_weight": False, "name": p["name"]}
+            atomic_write(p["sidecar"], json.dumps(sc))
+        shards.append(sc)
+    manifest = {
+        "version": STORE_VERSION, "key": key,
+        "mapper_digest": mapper_digest(mappers),
+        "mappers": [m.to_dict() for m in mappers],
+        "used_features": used,
+        "feature_names": [f"Column_{i}" for i in range(features)],
+        "num_total_features": features, "dtype": dtype.name,
+        "config": _config_key(config), "shards": shards,
+        "total_rows": int(rows),
+    }
+    atomic_write(os.path.join(cache_dir, MANIFEST),
+                 json.dumps(manifest))
+    return ShardStore(cache_dir, manifest)

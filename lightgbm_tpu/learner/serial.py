@@ -136,6 +136,71 @@ import os as _os_env
 _COMPILE_LEAN_ROWS = int(_os_env.environ.get("LGBM_TPU_COMPILE_LEAN_ROWS",
                                              65536))
 
+# canonical reduction chunk for the root statistics (ISSUE 14): a FIXED
+# constant, not a knob — the streamed out-of-core trainer
+# (boosting/streaming.py) reproduces the root sums from per-block chunk
+# sums, and any run-time variation here would silently fork the
+# reduction tree the byte-identity contract pins
+STREAM_CHUNK = 8192
+
+
+def _pairwise_halve(v: jnp.ndarray) -> jnp.ndarray:
+    """Reduce the LAST axis (a power of two) to 1 by repeated pairwise
+    adds.  Every step is an explicit elementwise ``a + b`` — defined
+    IEEE semantics XLA cannot legally reassociate — so the reduction
+    tree is identical in every fusion context and on every backend,
+    unlike a ``reduce`` op whose internal order is implementation-
+    defined (and empirically varies with the surrounding program)."""
+    while v.shape[-1] > 1:
+        half = v.shape[-1] // 2
+        v = v[..., :half] + v[..., half:]
+    return v[..., 0]
+
+
+def root_chunk_sums(grad, hess, bag) -> jnp.ndarray:
+    """Per-chunk partial sums of the root statistics ``(g, h, count)``
+    over a row range: ``-> [3, m]`` with ``m = ceil(n / STREAM_CHUNK)``.
+
+    The chunk grid is anchored at row 0 of the given range and padded
+    with exact zeros, and each chunk reduces through an explicit
+    pairwise-halving tree — so a caller that folds this function over
+    row blocks whose sizes are multiples of ``STREAM_CHUNK`` (the
+    streamed trainer, ``boosting/streaming.py``) produces the
+    identical ``[3, m]`` vector as one call over the whole range.
+    Partition-invariance is the contract
+    (tests/test_streaming.py pins it end-to-end)."""
+    n = grad.shape[0]
+    m = -(-n // STREAM_CHUNK)
+    pad = (0, m * STREAM_CHUNK - n)
+    g = jnp.pad(jnp.where(bag, grad, 0.0).astype(jnp.float32), pad)
+    h = jnp.pad(jnp.where(bag, hess, 0.0).astype(jnp.float32), pad)
+    c = jnp.pad(bag.astype(jnp.float32), pad)
+    stacked = jnp.stack([g, h, c])                   # [3, m*C]
+    return _pairwise_halve(stacked.reshape(3, m, STREAM_CHUNK))
+
+
+def reduce_chunk_sums(cs: jnp.ndarray):
+    """Reduce ``[3, m]`` chunk sums to root ``(sum_g, sum_h, cnt)``
+    with the same fixed pairwise-halving tree over the (zero-padded)
+    power-of-two chunk axis.  The tree depends only on ``m`` — never
+    on how the rows were partitioned into blocks — which is what makes
+    the streamed trainer's root statistics bitwise equal to the
+    resident path's."""
+    m = cs.shape[1]
+    P = 1 << max(0, (m - 1).bit_length())
+    v = jnp.pad(cs, ((0, 0), (0, P - m)))
+    v = _pairwise_halve(v)
+    return v[0], v[1], v[2]
+
+
+def root_stats(grad, hess, bag):
+    """Root ``(sum_g, sum_h, cnt)`` via the canonical chunked pairwise
+    reduction (replaces the old ``jnp.sum``, whose XLA ``reduce``
+    order is implementation-defined, varies with the surrounding
+    program, and cannot be reassembled from streamed per-block
+    partials)."""
+    return reduce_chunk_sums(root_chunk_sums(grad, hess, bag))
+
 
 def stage_plan(L: int, wave_size: int = 0):
     """Active-slot counts for the unrolled waves + the while-loop tail.
@@ -711,11 +776,13 @@ def _init_state(data: DeviceData, grad, hess, params: GrowthParams,
         row_value=jnp.zeros(0, jnp.float32),
     )
 
-    # root statistics (in-bag)
+    # root statistics (in-bag) via the canonical chunked reduction:
+    # partition-invariant by construction, so the streamed out-of-core
+    # trainer reproduces them bitwise from per-block chunk sums
+    # (boosting/streaming.py; the old jnp.sum reduction tree could not
+    # be reassembled from block partials)
     bag = (leaf2[1] == 0)
-    sum_g = jnp.sum(jnp.where(bag[:n], grad, 0.0))
-    sum_h = jnp.sum(jnp.where(bag[:n], hess, 0.0))
-    cnt = jnp.sum(bag.astype(jnp.float32))
+    sum_g, sum_h, cnt = root_stats(grad, hess, bag[:n])
     if psum_fn is not None:
         sum_g, sum_h, cnt = psum_fn((sum_g, sum_h, cnt))
 

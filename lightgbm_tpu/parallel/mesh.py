@@ -305,6 +305,20 @@ class MeshContext:
         return (n + d - 1) // d * d
 
 
+def shard_row_ranges(n: int, num_shards: int):
+    """The mesh row partition as explicit ``[(lo, hi), ...]`` global
+    ranges — the SAME contiguous equal-length layout ``pad_rows`` +
+    row sharding produce (shard ``d`` owns rows ``[d*per, (d+1)*per)``
+    of the padded space).  The streamed out-of-core trainer
+    (``boosting/streaming.py``) assigns blocks to shards through this,
+    which is what makes per-rank shard ownership compose with mesh row
+    sharding: streamed shard folds cover exactly the rows the
+    in-memory data-parallel mesh places on each device."""
+    d = max(1, num_shards)
+    per = (n + d - 1) // d
+    return [(i * per, (i + 1) * per) for i in range(d)]
+
+
 def make_mesh(num_devices: int, axis: str = "data",
               devices: Optional[Sequence] = None) -> Mesh:
     devices = list(devices if devices is not None else jax.devices())

@@ -43,7 +43,18 @@ the seams where production faults actually strike:
   element is poisoned to NaN (``boosting/gbdt._gradients``) —
   simulating the numerics-divergence class the window-boundary
   sentinels (``obs/health.py``) must catch with a ``health:nonfinite``
-  event naming the window and a ``/healthz`` flip to ``degraded``.
+  event naming the window and a ``/healthz`` flip to ``degraded``,
+* ``ingest.shard_fetch`` — the out-of-core shard ingest's per-shard
+  source fetch (``io/outofcore.py``: the ``localize()`` download of a
+  remote shard file — the fork's per-rank HDFS ``DownloadData``
+  analog); retried by the shared policy, so a flaky remote FS is a
+  transient, not a lost ingest,
+* ``ingest.cache_write`` — mid-shard while appending binned blocks to
+  the on-disk shard cache (power loss / preemption during ingest); the
+  torn blob stays under its tmp name, the shard's sidecar is never
+  published, and a re-run re-ingests exactly the unfinished shards —
+  the manifest is written last, so a killed ingest can never be
+  mistaken for a complete one.
 
 Each point is a single ``fault_point(name)`` call that is a no-op unless
 armed.  Tests arm points programmatically (:func:`inject`, or the
@@ -69,7 +80,8 @@ from typing import Dict, Optional
 
 POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
           "loader.read", "spmd.skip_record", "serve.score", "mem.leak",
-          "det.rng_drift", "watchdog.stall", "health.nan_grad")
+          "det.rng_drift", "watchdog.stall", "health.nan_grad",
+          "ingest.shard_fetch", "ingest.cache_write")
 
 
 class FaultInjected(RuntimeError):

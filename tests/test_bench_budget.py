@@ -95,12 +95,13 @@ def test_deadline_skips_aux_legs_with_markers(bench_run):
     assert "partial" not in final           # the complete line
     assert final["value"] > 0               # headline retained
     for leg in ("serve", "serve_load", "valid", "bin255", "rank", "rank63",
-                "multichip", "split_finder", "rank_grad", "attribution"):
+                "multichip", "split_finder", "rank_grad", "attribution",
+                "stream"):
         assert final.get(f"{leg}_leg") == "skipped: budget", final
     assert final.get("real_data") == "skipped: budget"
     assert set(final.get("legs_skipped", [])) >= {
         "serve", "serve_load", "valid", "bin255", "rank", "rank63",
-        "multichip", "split_finder", "rank_grad", "attribution"}
+        "multichip", "split_finder", "rank_grad", "attribution", "stream"}
     # an explicit skip is not a failure: no legs_failed / hard-failed
     assert "legs_failed" not in final
     assert "legs_hard_failed" not in final
@@ -220,6 +221,27 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     for key in ("split_finder", "rank_grad"):
         assert out["north_star_aux_detail"][key] in (
             "measured", "pending-capture"), out["north_star_aux_detail"]
+    # stream_ingest gate (ISSUE 14): the REAL out-of-core leg ran at
+    # toy shape — multi-shard ingest into the mmap store, MULTI-block
+    # streamed training BYTE-identical to resident in-memory training,
+    # a real SIGKILL mid-ingest resuming to the clean manifest, and
+    # the throughput/memory schema the TPU artifact will record
+    assert out["stream_schema_ok"] is True, out.get(
+        "stream_leg", out.get("stream_schema_missing"))
+    from bench import STREAM_SCHEMA_KEYS
+    for key in STREAM_SCHEMA_KEYS:
+        assert key in out, key
+    assert out["stream_identity_ok"] is True
+    assert out["stream_resume_ok"] is True
+    assert out["stream_shards"] > 1          # multi-shard store
+    assert out["stream_rows"] > out["stream_block_rows"]  # multi-block
+    assert out["stream_ingest_rows_per_sec"] > 0
+    assert out["stream_row_iters_per_sec"] > 0
+    assert out["stream_host_rss_peak_bytes"] > 0
+    assert isinstance(out["stream_model_digest"], str) \
+        and len(out["stream_model_digest"]) == 64
+    assert out["north_star_aux_detail"]["stream_ingest"] in (
+        "measured", "pending-capture"), out["north_star_aux_detail"]
     # device-time attribution gate (ISSUE 10): the REAL leg ran at toy
     # shape — windowed LGBM_TPU_PROFILE capture, parsed, >= 90% of the
     # captured device time attributed to named spans, host-gap and
@@ -260,7 +282,8 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     # peak_hbm_bytes — int > 0 with allocator stats, else null + reason
     assert out["peak_hbm_schema_ok"] is True, out
     for key in ("peak_hbm_bytes", "waves_peak_hbm_bytes",
-                "multichip_peak_hbm_bytes", "serve_peak_hbm_bytes"):
+                "multichip_peak_hbm_bytes", "serve_peak_hbm_bytes",
+                "stream_peak_hbm_bytes"):
         assert key in out, key
         if out[key] is None:
             assert out.get("peak_hbm_reason"), out

@@ -1,0 +1,296 @@
+"""Out-of-core shard store (ISSUE 14): ingest correctness, cache
+keying, crash-resume, and the fault seams.
+
+The acceptance surface:
+* multi-file global-sample-index discipline — mappers byte-identical
+  to the in-memory path over the concatenated file (and to a
+  single-file ingest);
+* cache hit (no re-ingest) vs stale cache REJECTED on a binning-knob
+  change (mapper-digest mismatch class);
+* resumable ingest: a SIGKILL mid-ingest (real subprocess) leaves no
+  manifest, finished shards are reused, torn shards re-ingested;
+* edge cases: empty shard file, single-row tail, blocks spanning
+  shard boundaries;
+* ``ingest.shard_fetch`` / ``ingest.cache_write`` fault points retried
+  by the shared policy (PR 1 style).
+"""
+import json
+import os
+import signal
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io import outofcore as oc
+from lightgbm_tpu.io.dataset import BinnedDataset, Metadata
+from lightgbm_tpu.io.loader import parse_file
+from lightgbm_tpu.utils import faults
+
+PARAMS = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+          "verbose": -1}
+
+
+def _write_sources(tmp, n=3000, f=6, parts=(0.3, 0.75, 1.0), seed=3):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.4 * X[:, 1] + rng.normal(scale=0.4, size=n) > 0
+         ).astype(np.float32)
+    rows = np.concatenate([y[:, None], X], axis=1)
+    bounds = [0] + [int(p * n) for p in parts]
+    srcs = []
+    for i in range(len(parts)):
+        p = os.path.join(tmp, f"part{i}.csv")
+        np.savetxt(p, rows[bounds[i]:bounds[i + 1]], delimiter=",",
+                   fmt="%.9g")
+        srcs.append(p)
+    single = os.path.join(tmp, "all.csv")
+    np.savetxt(single, rows, delimiter=",", fmt="%.9g")
+    return srcs, single, X, y
+
+
+@pytest.fixture()
+def sources(tmp_path):
+    return _write_sources(str(tmp_path))
+
+
+def test_multi_file_sample_parity(tmp_path, sources):
+    """The 3-file ingest's mappers equal the in-memory path over the
+    single concatenated file — the global-sample-index discipline."""
+    srcs, single, X, y = sources
+    cfg = Config.from_params(PARAMS)
+    store = oc.ingest(srcs, cfg, str(tmp_path / "cache"))
+    Xp, yp, _, _, _, _ = parse_file(single, cfg)
+    md = Metadata()
+    md.set_field("label", yp)
+    ds = BinnedDataset.from_raw(Xp, cfg, metadata=md)
+    assert len(store.mappers) == len(ds.mappers)
+    for a, b in zip(store.mappers, ds.mappers):
+        assert a.to_dict() == b.to_dict()
+    # and the binned rows are identical (same bins, same row order)
+    bins, label, _ = store.read_rows(0, store.n)
+    assert np.array_equal(np.asarray(bins), ds.bins)
+    assert np.array_equal(np.asarray(label), yp)
+    # single-file ingest agrees too
+    store1 = oc.ingest([single], cfg, str(tmp_path / "cache1"))
+    assert oc.mapper_digest(store1.mappers) == oc.mapper_digest(store.mappers)
+
+
+def test_cache_hit_skips_reingest(tmp_path, sources):
+    srcs, _, _, _ = sources
+    cfg = Config.from_params(PARAMS)
+    cache = str(tmp_path / "cache")
+    oc.ingest(srcs, cfg, cache)
+    mtimes = {f: os.path.getmtime(os.path.join(cache, f))
+              for f in os.listdir(cache)}
+    store = oc.ingest(srcs, cfg, cache)       # second call: pure hit
+    assert store.n == 3000
+    for f, t in mtimes.items():
+        assert os.path.getmtime(os.path.join(cache, f)) == t, \
+            f"{f} was rewritten on a cache hit"
+
+
+def test_stale_cache_rejected_on_mapper_knob_change(tmp_path, sources):
+    """A changed binning knob (different mappers) must invalidate the
+    cache — a stale cache never silently trains."""
+    srcs, _, _, _ = sources
+    cache = str(tmp_path / "cache")
+    s1 = oc.ingest(srcs, Config.from_params(PARAMS), cache)
+    d1 = s1.manifest["mapper_digest"]
+    cfg2 = Config.from_params(dict(PARAMS, max_bin=15))
+    assert oc.load_store(cache, srcs, cfg2) is None
+    s2 = oc.ingest(srcs, cfg2, cache)
+    assert s2.manifest["mapper_digest"] != d1
+    assert max(m.num_bin for m in s2.mappers) <= 16
+
+
+def test_stale_cache_rejected_on_source_change(tmp_path, sources):
+    srcs, _, _, _ = sources
+    cfg = Config.from_params(PARAMS)
+    cache = str(tmp_path / "cache")
+    oc.ingest(srcs, cfg, cache)
+    with open(srcs[1], "a") as f:
+        f.write("1.0," + ",".join(["0.5"] * 6) + "\n")
+    assert oc.load_store(cache, srcs, cfg) is None
+    store = oc.ingest(srcs, cfg, cache)
+    assert store.n == 3001
+
+
+def test_torn_shard_is_reingested(tmp_path, sources):
+    """A truncated published blob (torn by a crash or filesystem) must
+    be detected and re-ingested, never trained on."""
+    srcs, _, _, _ = sources
+    cfg = Config.from_params(PARAMS)
+    cache = str(tmp_path / "cache")
+    s1 = oc.ingest(srcs, cfg, cache)
+    bins0, _, _ = s1.read_rows(0, s1.n)
+    bins0 = np.array(bins0)
+    blob = os.path.join(cache, "shard-0001.bins")
+    with open(blob, "r+b") as f:
+        f.truncate(os.path.getsize(blob) // 2)
+    assert oc.load_store(cache, srcs, cfg) is None
+    s2 = oc.ingest(srcs, cfg, cache)
+    bins1, _, _ = s2.read_rows(0, s2.n)
+    assert np.array_equal(bins0, np.asarray(bins1))
+
+
+def test_empty_shard_and_single_row_tail(tmp_path):
+    """An empty source file is a valid 0-row shard; a 1-row file is a
+    valid 1-row tail; block reads spanning shard boundaries agree with
+    the concatenation."""
+    rng = np.random.RandomState(5)
+    X = rng.normal(size=(257, 4))
+    y = (X[:, 0] > 0).astype(np.float32)
+    rows = np.concatenate([y[:, None], X], axis=1)
+    p0 = os.path.join(str(tmp_path), "a.csv")
+    p1 = os.path.join(str(tmp_path), "empty.csv")
+    p2 = os.path.join(str(tmp_path), "tail.csv")
+    np.savetxt(p0, rows[:256], delimiter=",", fmt="%.9g")
+    open(p1, "w").close()
+    np.savetxt(p2, rows[256:], delimiter=",", fmt="%.9g")
+    cfg = Config.from_params(PARAMS)
+    store = oc.ingest([p0, p1, p2], cfg, str(tmp_path / "cache"))
+    assert store.n == 257
+    assert store.manifest["shards"][1]["rows"] == 0
+    assert store.manifest["shards"][2]["rows"] == 1
+    whole, label, _ = store.read_rows(0, 257)
+    # a read spanning the empty shard and the 1-row tail
+    span, lspan, _ = store.read_rows(200, 257)
+    assert np.array_equal(np.asarray(span), np.asarray(whole)[200:])
+    assert np.array_equal(np.asarray(lspan), np.asarray(label)[200:])
+
+
+def test_shard_fetch_fault_is_retried(tmp_path, sources):
+    """PR 1 style: a transient shard-fetch fault recovers through the
+    shared retry policy."""
+    srcs, _, _, _ = sources
+    cfg = Config.from_params(PARAMS)
+    from lightgbm_tpu.utils import retry
+    orig_sleep = retry._sleep
+    retry._sleep = lambda s: None
+    try:
+        with faults.injected("ingest.shard_fetch", times=2):
+            store = oc.ingest(srcs, cfg, str(tmp_path / "cache"))
+            assert faults.fired("ingest.shard_fetch") == 2
+        assert store.n == 3000
+    finally:
+        retry._sleep = orig_sleep
+
+
+def test_cache_write_fault_reingests_shard(tmp_path, sources):
+    """A transient mid-shard write fault: the torn .tmp is discarded
+    and the shard re-ingests on the retry — the final store equals a
+    clean ingest's."""
+    srcs, _, _, _ = sources
+    cfg = Config.from_params(PARAMS)
+    from lightgbm_tpu.utils import retry
+    orig_sleep = retry._sleep
+    retry._sleep = lambda s: None
+    try:
+        with faults.injected("ingest.cache_write", times=1):
+            store = oc.ingest(srcs, cfg, str(tmp_path / "cache"))
+            assert faults.fired("ingest.cache_write") == 1
+    finally:
+        retry._sleep = orig_sleep
+    clean = oc.ingest(srcs, cfg, str(tmp_path / "clean"))
+    assert [s["sha256"] for s in store.manifest["shards"]] == \
+        [s["sha256"] for s in clean.manifest["shards"]]
+
+
+def test_nontransient_cache_write_fault_leaves_no_manifest(tmp_path,
+                                                          sources):
+    """kill-mid-ingest leaves the manifest VALID (absent counts): a
+    hard fault mid-shard must not publish a manifest, and the next run
+    resumes over the finished shards."""
+    srcs, _, _, _ = sources
+    cfg = Config.from_params(PARAMS)
+    cache = str(tmp_path / "cache")
+    from lightgbm_tpu.utils import retry
+    orig_sleep = retry._sleep
+    retry._sleep = lambda s: None
+    try:
+        # non-transient + more shots than retry attempts: ingest dies
+        with faults.injected("ingest.cache_write", times=10,
+                             transient=False):
+            with pytest.raises(faults.FaultInjected):
+                oc.ingest(srcs, cfg, cache)
+    finally:
+        retry._sleep = orig_sleep
+    assert not os.path.exists(os.path.join(cache, oc.MANIFEST))
+    # shard 0 wrote no sidecar -> fully re-ingested on the next run
+    store = oc.ingest(srcs, cfg, cache)
+    assert store.n == 3000
+    assert os.path.exists(os.path.join(cache, oc.MANIFEST))
+
+
+def test_sigkill_mid_ingest_resumes_to_same_manifest(tmp_path, sources):
+    """A real SIGKILL mid-ingest (subprocess): the cache directory has
+    finished shards but NO manifest; re-running ingest reuses the
+    finished shards and commits the same manifest a clean ingest
+    produces."""
+    srcs, _, _, _ = sources
+    cache = str(tmp_path / "cache")
+    child = textwrap.dedent(f"""
+        import json, os, signal, sys
+        sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.io import outofcore as oc
+        done = 0
+        orig = oc._ingest_one_shard
+        def killer(k, *a, **kw):
+            global done
+            out = orig(k, *a, **kw)
+            done += 1
+            if done == 2:
+                os.kill(os.getpid(), signal.SIGKILL)   # die mid-ingest
+            return out
+        oc._ingest_one_shard = killer
+        oc.ingest({srcs!r}, Config.from_params({PARAMS!r}), {cache!r})
+    """)
+    proc = subprocess.run([sys.executable, "-c", child],
+                          capture_output=True, timeout=300)
+    assert proc.returncode == -signal.SIGKILL
+    assert not os.path.exists(os.path.join(cache, oc.MANIFEST))
+    # finished shards carry sidecars; the third does not
+    assert os.path.exists(os.path.join(cache, "shard-0000.json"))
+    assert os.path.exists(os.path.join(cache, "shard-0001.json"))
+    assert not os.path.exists(os.path.join(cache, "shard-0002.json"))
+    mt0 = os.path.getmtime(os.path.join(cache, "shard-0000.bins"))
+    cfg = Config.from_params(PARAMS)
+    store = oc.ingest(srcs, cfg, cache)     # resume
+    assert os.path.getmtime(
+        os.path.join(cache, "shard-0000.bins")) == mt0   # reused
+    clean = oc.ingest(srcs, cfg, str(tmp_path / "clean"))
+    assert store.manifest["key"] == clean.manifest["key"]
+    assert [s["sha256"] for s in store.manifest["shards"]] == \
+        [s["sha256"] for s in clean.manifest["shards"]]
+    assert store.manifest["mapper_digest"] == \
+        clean.manifest["mapper_digest"]
+
+
+def test_per_rank_file_sharding(tmp_path, sources):
+    """Rank r of S owns sources[r::S] (the DownloadData ownership
+    rule); the union of rank stores covers every row exactly once."""
+    srcs, _, _, y = sources
+    cfg = Config.from_params(PARAMS)
+    assert oc.shard_sources(srcs, 0, 2) == [srcs[0], srcs[2]]
+    assert oc.shard_sources(srcs, 1, 2) == [srcs[1]]
+    s0 = oc.ingest(srcs, cfg, str(tmp_path / "r0"), rank=0, num_ranks=2)
+    s1 = oc.ingest(srcs, cfg, str(tmp_path / "r1"), rank=1, num_ranks=2)
+    assert s0.n + s1.n == 3000
+
+
+def test_ranking_group_column_rejected(tmp_path):
+    rng = np.random.RandomState(0)
+    rows = np.concatenate([rng.rand(50, 1), np.repeat(np.arange(5), 10)[:, None],
+                           rng.rand(50, 3)], axis=1)
+    p = os.path.join(str(tmp_path), "q.csv")
+    np.savetxt(p, rows, delimiter=",", fmt="%.9g")
+    cfg = Config.from_params(dict(PARAMS, group_column="1"))
+    with pytest.raises(ValueError, match="ranking"):
+        oc.ingest([p], cfg, str(tmp_path / "cache"))

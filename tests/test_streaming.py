@@ -1,0 +1,209 @@
+"""Streamed out-of-core training (ISSUE 14): the byte-identity
+contract of the ``LGBM_TPU_STREAM_ROWS`` seam (detcheck DET005
+``stream-vs-resident``).
+
+Streamed training — rows in the mmap shard cache, multi-block
+host→device streaming, host-resident scores — must be BYTE-IDENTICAL
+(model text + score digests via ``Booster.digest()``) to in-memory
+``lgb.train`` on the same data, for serial AND 2-shard data-parallel,
+on the exact-accumulation scatter backend (the CPU default).  Plus:
+source independence (mmap cache vs resident RAM), block-size
+invariance, tail blocks, and the documented descopes.
+"""
+import os
+
+import numpy as np
+import jax
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.boosting.streaming import (StreamTrainer, stream_rows,
+                                             train_streaming)
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io import outofcore as oc
+from lightgbm_tpu.io.dataset import BinnedDataset, Metadata
+from lightgbm_tpu.learner.serial import STREAM_CHUNK
+
+N, F = 12000, 6          # > STREAM_CHUNK -> multi-block at R=8192
+BASE = {"objective": "binary", "num_leaves": 15, "max_bin": 63,
+        "learning_rate": 0.1, "num_iterations": 5, "verbose": -1}
+
+
+def _data(seed=7, n=N):
+    rng = np.random.RandomState(seed)
+    X = rng.normal(size=(n, F))
+    y = (X[:, 0] + 0.5 * X[:, 1] + rng.normal(scale=0.3, size=n) > 0
+         ).astype(np.float32)
+    return X, y
+
+
+def _resident(X, y, params):
+    cfg = Config.from_params(params)
+    md = Metadata()
+    md.set_field("label", y)
+    return cfg, BinnedDataset.from_raw(X, cfg, metadata=md)
+
+
+def _mem_digest(X, y, params):
+    ds = lgb.Dataset(X, label=y, params=params)
+    return lgb.train(params, ds)._gbdt.digest()
+
+
+def _stream_digest(params, source, rounds=None, block_rows=STREAM_CHUNK):
+    cfg = Config.from_params(params)
+    tr = StreamTrainer(cfg, source, block_rows=block_rows)
+    assert len(tr._blocks()) > 1, "parity must exercise MULTI-block"
+    return tr.train(rounds or params["num_iterations"]).digest()
+
+
+def test_streamed_cache_byte_identical_to_in_memory(tmp_path):
+    """THE gate: multi-block streamed training from the mmap shard
+    cache == in-memory training, model text AND scores."""
+    X, y = _data()
+    rows = np.concatenate([y[:, None], X], axis=1)
+    srcs = []
+    for i, (a, b) in enumerate([(0, 5000), (5000, N)]):
+        p = os.path.join(str(tmp_path), f"p{i}.csv")
+        np.savetxt(p, rows[a:b], delimiter=",", fmt="%.9g")
+        srcs.append(p)
+    cfg = Config.from_params(BASE)
+    store = oc.ingest(srcs, cfg, str(tmp_path / "cache"))
+    # in-memory side trains on the SAME binned rows (ingest parity is
+    # pinned separately in tests/test_outofcore.py)
+    from lightgbm_tpu.io.loader import parse_file
+    single = os.path.join(str(tmp_path), "all.csv")
+    np.savetxt(single, rows, delimiter=",", fmt="%.9g")
+    Xp, yp, _, _, _, _ = parse_file(single, cfg)
+    d_mem = _mem_digest(Xp, yp, BASE)
+    d_str = _stream_digest(BASE, store)
+    assert d_str == d_mem
+
+
+def test_streamed_resident_source_byte_identical():
+    """Source independence half: streaming the resident dataset's own
+    arrays produces the in-memory digest too (so cache==resident==
+    in-memory all agree)."""
+    X, y = _data()
+    cfg, res = _resident(X, y, BASE)
+    assert _stream_digest(BASE, res) == _mem_digest(X, y, BASE)
+
+
+def test_block_size_invariance():
+    """R=8192 and R=2*8192 produce the identical model: the fold/
+    chunk-reduction contract, not a lucky block count."""
+    X, y = _data(seed=11, n=3 * STREAM_CHUNK + 123)
+    cfg, res = _resident(X, y, BASE)
+    d1 = _stream_digest(BASE, res, block_rows=STREAM_CHUNK)
+    cfg2, res2 = _resident(X, y, BASE)
+    tr = StreamTrainer(cfg2, res2, block_rows=2 * STREAM_CHUNK)
+    d2 = tr.train(BASE["num_iterations"]).digest()
+    assert d1 == d2 == _mem_digest(X, y, BASE)
+
+
+def test_feature_fraction_parity():
+    X, y = _data()
+    params = dict(BASE, feature_fraction=0.5)
+    cfg, res = _resident(X, y, params)
+    assert _stream_digest(params, res) == _mem_digest(X, y, params)
+
+
+def test_multiclass_parity():
+    X, y = _data()
+    ym = np.digitize(X[:, 0], [-0.5, 0.5]).astype(np.float32)
+    params = {"objective": "multiclass", "num_class": 3, "num_leaves": 7,
+              "max_bin": 31, "learning_rate": 0.1, "num_iterations": 3,
+              "verbose": -1}
+    cfg, res = _resident(X, ym, params)
+    assert _stream_digest(params, res) == _mem_digest(X, ym, params)
+
+
+def test_regression_with_weights_parity():
+    rng = np.random.RandomState(3)
+    X, _ = _data(seed=3)
+    y = (X[:, 0] * 2 + rng.normal(size=N)).astype(np.float32)
+    w = np.abs(rng.normal(size=N)).astype(np.float32) + 0.1
+    params = dict(BASE, objective="regression")
+    cfg = Config.from_params(params)
+    md = Metadata()
+    md.set_field("label", y)
+    md.set_field("weight", w)
+    res = BinnedDataset.from_raw(X, cfg, metadata=md)
+    ds = lgb.Dataset(X, label=y, weight=w, params=params)
+    d_mem = lgb.train(params, ds)._gbdt.digest()
+    assert _stream_digest(params, res) == d_mem
+
+
+@pytest.mark.skipif(len(jax.devices()) < 2,
+                    reason="needs >=2 virtual devices")
+def test_two_shard_data_parallel_parity():
+    """Streamed per-shard block folds == the in-memory 2-shard
+    data-parallel mesh (fused blocks, overlapped psum schedule), with
+    an ODD row count so the mesh row padding path is exercised."""
+    X, y = _data(seed=9, n=2 * STREAM_CHUNK + 4001)   # odd -> pad row
+    params = dict(BASE, tree_learner="data", mesh_shape=[2])
+    cfg, res = _resident(X, y, params)
+    tr = StreamTrainer(cfg, res, block_rows=STREAM_CHUNK)
+    assert tr.S == 2
+    d_str = tr.train(BASE["num_iterations"]).digest()
+    assert d_str == _mem_digest(X, y, params)
+
+
+def test_model_roundtrip_and_prediction(tmp_path):
+    """The streamed booster is a regular booster: save/load text
+    round-trips and predictions work through the mapper shell."""
+    X, y = _data()
+    cfg, res = _resident(X, y, BASE)
+    bst = StreamTrainer(cfg, res, block_rows=STREAM_CHUNK).train(5)
+    text = bst.save_model_to_string()
+    loaded = lgb.Booster(model_str=text)
+    pred = loaded.predict(X[:128])
+    assert pred.shape == (128,)
+    assert np.isfinite(pred).all()
+    assert pred.std() > 0          # the model actually learned something
+    # the shell booster predicts directly too (binned fast path vs the
+    # loaded model's raw-threshold walk: same trees, float-path class)
+    direct = bst.predict(X[:128])
+    np.testing.assert_allclose(direct, pred, rtol=0, atol=1e-4)
+
+
+def test_stream_rows_env_rounds_to_chunk(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_STREAM_ROWS", "1000")
+    assert stream_rows() == STREAM_CHUNK
+    monkeypatch.setenv("LGBM_TPU_STREAM_ROWS", str(STREAM_CHUNK + 1))
+    assert stream_rows() == 2 * STREAM_CHUNK
+    monkeypatch.delenv("LGBM_TPU_STREAM_ROWS")
+    assert stream_rows() == 0
+
+
+def test_descopes_raise():
+    X, y = _data(n=STREAM_CHUNK)
+    for extra, match in (
+            ({"bagging_fraction": 0.5, "bagging_freq": 1}, "bagging"),
+            ({"boosting": "dart"}, "boosting"),
+            ({"boosting": "goss"}, "boosting"),
+            ({"tree_learner": "voting"}, "tree_learner"),
+            ({"objective": "lambdarank"}, "rank")):
+        params = dict(BASE, **extra)
+        cfg = Config.from_params(params)
+        md = Metadata()
+        md.set_field("label", y)
+        if "rank" in str(extra.get("objective", "")):
+            md.set_field("group", np.full(N // 100, 100, np.int32))
+        res = BinnedDataset.from_raw(X, cfg, metadata=md)
+        with pytest.raises(ValueError, match=match):
+            StreamTrainer(cfg, res)
+
+
+def test_train_streaming_public_surface(tmp_path):
+    """lgb.train_streaming over a file list: ingest + train end to
+    end, digest equal to the resident-source streamed run."""
+    X, y = _data(seed=13, n=9000)
+    rows = np.concatenate([y[:, None], X], axis=1)
+    p = os.path.join(str(tmp_path), "all.csv")
+    np.savetxt(p, rows, delimiter=",", fmt="%.9g")
+    params = dict(BASE, num_iterations=3)
+    bst = lgb.train_streaming(params, [p],
+                              cache_dir=str(tmp_path / "cache"))
+    assert bst.num_trees() == 3
+    assert os.path.exists(os.path.join(str(tmp_path / "cache"),
+                                       oc.MANIFEST))

@@ -125,6 +125,13 @@ PROGRAM_PAIRS: Tuple[Dict, ...] = (
      "programs": ("pure (drop_seed, iteration)-keyed drop derivation",
                   "legacy stateful np.random.RandomState stream"),
      "test": "tests/test_determinism.py"},
+    {"name": "stream-vs-resident",
+     "env": "LGBM_TPU_STREAM_ROWS",
+     "programs": ("streamed block trainer (boosting/streaming.py: "
+                  "out-of-core mmap blocks, carried-accumulator "
+                  "histogram folds, host-resident scores)",
+                  "resident in-memory fused training loop"),
+     "test": "tests/test_streaming.py"},
 )
 
 # knobs that branch inside jit-bearing modules but do not choose
@@ -198,6 +205,10 @@ EXEMPT_ENV: Dict[str, str] = {
     "LGBM_TPU_RETRY_DEADLINE_S": "retry policy knob",
     "LGBM_TPU_RETRY_JITTER": "retry backoff jitter; never reaches model "
                              "state",
+    "LGBM_TPU_STREAM_CACHE": "out-of-core shard-cache directory "
+                             "override (io/outofcore.py); storage "
+                             "location only, the cache key still "
+                             "validates content",
 }
 
 # -- DET004: first-max tie-break contracts -------------------------------

@@ -101,7 +101,7 @@ def bin_stride(max_bin: int) -> int:
 @dataclass
 class Target:
     name: str
-    kind: str                    # "train" | "serve"
+    kind: str                    # "train" | "serve" | "stream"
     budget_bytes: int
     rows: int = 0
     features: int = 0
@@ -113,6 +113,7 @@ class Target:
     devices: int = 1             # data-parallel mesh size (fused block)
     trees: int = 0               # serve
     bucket_rows: int = 0         # serve
+    stream_rows: int = 0         # stream: LGBM_TPU_STREAM_ROWS block
     slack: float = 1.25
 
 
@@ -150,6 +151,7 @@ def load_targets(path: str) -> Tuple[List[Target], Optional[str]]:
                 devices=max(1, int(t.get("devices", 1))),
                 trees=int(t.get("trees", 0)),
                 bucket_rows=int(t.get("bucket_rows", 0)),
+                stream_rows=int(t.get("stream_rows", 0)),
                 slack=float(t.get("slack", 1.25))))
     except (KeyError, TypeError, ValueError) as exc:
         return [], f"bad target spec: {type(exc).__name__}: {exc}"
@@ -216,5 +218,40 @@ def serve_footprint(t: Target) -> Footprint:
     return fp
 
 
+def stream_footprint(t: Target) -> Footprint:
+    """Per-device live bytes of one streamed-training wave dispatch
+    (ISSUE 14, ``boosting/streaming.py``): device memory is charged
+    PER BLOCK — ``stream_rows`` rows in flight (one block live + one
+    double-buffered upload), never the dataset — plus the resident
+    per-leaf state (histograms, split cache, tree arrays), which is
+    what the out-of-core memory contract means.  The ``rows`` field is
+    documentation (the dataset scale the target represents); it never
+    enters the device arithmetic, and the bench leg's runtime
+    watermark (``stream_peak_hbm_bytes``) is the empirical half of the
+    same claim."""
+    R, F, K = t.stream_rows, t.features, max(1, t.classes)
+    B = bin_stride(t.max_bin)
+    fp = Footprint()
+    # one block resident + one in flight (double buffer)
+    fp.parts["block_bins"] = 2 * R * F
+    fp.parts["block_grad_hess"] = 2 * 2 * R * 4
+    fp.parts["block_leaf2"] = 2 * 2 * R * 4
+    fp.parts["block_scores"] = 2 * R * K * 4
+    # resident per-leaf state: the wave accumulator (per shard), the
+    # sibling-subtract histogram state, split-scan intermediates
+    fp.parts["wave_acc"] = WAVE_SLOT_CAP * F * B * 3 * 4
+    fp.parts["hist_state"] = t.leaves * F * B * 3 * 4
+    scan_slots = max(min(2 * WAVE_SLOT_CAP, 2 * t.leaves), t.leaves)
+    fp.parts["split_scan"] = _split_scan_part(scan_slots, F, B)
+    fp.parts["tree_arrays"] = K * t.leaves * 8 * 4
+    for k in fp.parts:
+        fp.parts[k] = int(fp.parts[k] * t.slack)
+    return fp
+
+
 def target_footprint(t: Target) -> Footprint:
-    return serve_footprint(t) if t.kind == "serve" else train_footprint(t)
+    if t.kind == "serve":
+        return serve_footprint(t)
+    if t.kind == "stream":
+        return stream_footprint(t)
+    return train_footprint(t)
