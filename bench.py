@@ -1329,6 +1329,95 @@ def stream_child():
                         shard_rows=shard_rows)
 
 
+# keys the elastic (chaos recovery) leg must emit — `--dryrun` validates
+# them plus the SIGKILL shrink+regrow byte-identity verdict
+ELASTIC_SCHEMA_KEYS = (
+    "elastic_workers", "elastic_shards", "elastic_iters",
+    "elastic_kill_iter", "elastic_respawned", "elastic_recovery_ok",
+    "elastic_identity_ok", "elastic_wall_s", "elastic_oracle_sha256")
+
+
+def elastic_leg(line=None, dryrun: bool = False):
+    """Elastic-recovery chaos gate (ISSUE 16): run ``tools/chaos.py``
+    for record — a REAL 2-process elastic run (``parallel/elastic.py``
+    + ``train_elastic``), SIGKILL one worker the moment its heartbeat
+    reports the kill iteration, shrink to world 1, regrow with a
+    replacement joiner, and demand every survivor's final model text
+    sha AND score digest equal the uninterrupted single-process
+    oracle's.
+
+    The whole scenario runs on CPU regardless of the bench backend:
+    the identity domain is (data, config, S) on the host collective
+    path — there is no device throughput to measure, and the oracle
+    must share the workers' platform for the byte comparison to mean
+    anything.  When the bench process itself is already on CPU the
+    launcher runs in-process (the chaos WORKERS are real subprocesses
+    either way — the SIGKILL is always against a live pid); a non-CPU
+    bench shells out so the oracle trains on the workers' platform."""
+    import shutil
+    import subprocess
+    import sys as _sys
+    import tempfile
+
+    import jax
+
+    workers = int(os.environ.get("BENCH_ELASTIC_WORKERS", 2))
+    iters = int(os.environ.get(
+        "BENCH_ELASTIC_ITERS", 3 if dryrun else 4))
+    rows = int(os.environ.get(
+        "BENCH_ELASTIC_ROWS", 192 if dryrun else 256))
+    kill_iter = int(os.environ.get(
+        "BENCH_ELASTIC_KILL_ITER", 1 if dryrun else 2))
+    repo = os.path.dirname(os.path.abspath(__file__))
+    rundir = tempfile.mkdtemp(prefix="lgbm_elastic_leg_")
+    t0 = time.time()
+    try:
+        if jax.default_backend() == "cpu":
+            from tools.chaos import run_chaos
+            verdict = run_chaos(
+                workers=workers, shards=workers, iters=iters, rows=rows,
+                features=6, leaves=7, snapshot_freq=1,
+                kill_iter=kill_iter, respawn=True, rundir=rundir,
+                timeout_s=300.0)
+        else:
+            env = {**os.environ, "JAX_PLATFORMS": "cpu",
+                   "PYTHONPATH": repo + os.pathsep
+                   + os.environ.get("PYTHONPATH", "")}
+            env.pop("XLA_FLAGS", None)
+            argv = [_sys.executable, "-m", "tools.chaos",
+                    "--workers", str(workers), "--shards", str(workers),
+                    "--iters", str(iters), "--rows", str(rows),
+                    "--features", "6", "--leaves", "7",
+                    "--snapshot-freq", "1",
+                    "--kill-iter", str(kill_iter), "--respawn",
+                    "--rundir", rundir, "--timeout", "300", "--json"]
+            proc = subprocess.run(argv, cwd=repo, env=env,
+                                  capture_output=True, text=True,
+                                  timeout=600)
+            if "{" not in proc.stdout:
+                raise RuntimeError(
+                    f"chaos harness emitted no verdict "
+                    f"(rc={proc.returncode}): {proc.stderr[-500:]}")
+            verdict = json.loads(proc.stdout[proc.stdout.index("{"):])
+    finally:
+        shutil.rmtree(rundir, ignore_errors=True)
+    out = {
+        "elastic_workers": workers, "elastic_shards": workers,
+        "elastic_iters": iters, "elastic_kill_iter": kill_iter,
+        "elastic_respawned": verdict.get("respawned"),
+        "elastic_recovery_ok": bool(
+            verdict.get("killed") and verdict.get("respawned")
+            and len(verdict.get("results", [])) == workers),
+        "elastic_identity_ok": bool(verdict.get("ok")),
+        "elastic_wall_s": round(time.time() - t0, 3),
+        "elastic_oracle_sha256": verdict.get("oracle", {}).get(
+            "model_sha256", ""),
+    }
+    if verdict.get("errors"):
+        out["elastic_errors"] = verdict["errors"]
+    return out
+
+
 def _validate_north_star_aux(ns: dict):
     """Validate the extended north_star.json tables: each aux wave key
     is either a measured list of rows (positive ns/row) or a
@@ -1430,6 +1519,19 @@ def _validate_north_star_aux(ns: dict):
                 and int(si.get("rows", 0)) >= 100_000_000)
     detail["stream_ingest"] = ("measured" if measured_si and good else
                                ("pending-capture" if good else "invalid"))
+    ok = ok and good
+    # elastic (ISSUE 16): a measured dict with passing recovery +
+    # identity verdicts, or an explicit pending-capture spec
+    el = ns.get("elastic")
+    measured_el = isinstance(el, dict) and "identity_ok" in el
+    if measured_el:
+        good = bool(el.get("identity_ok")) and bool(el.get("recovery_ok"))
+    else:
+        good = (isinstance(el, dict)
+                and el.get("status") == "pending-capture"
+                and int(el.get("workers", 0)) >= 2)
+    detail["elastic"] = ("measured" if measured_el and good else
+                         ("pending-capture" if good else "invalid"))
     return ok and good, detail
 
 
@@ -1586,6 +1688,24 @@ def dryrun_main():
     except Exception as exc:        # noqa: BLE001 - reported on the line
         line["stream_schema_ok"] = False
         line["stream_leg"] = f"failed: {type(exc).__name__}: {exc}"
+    # elastic chaos gate (ISSUE 16): the REAL SIGKILL shrink+regrow
+    # scenario in a CPU subprocess — the survivor and the replacement
+    # joiner must both land on the 1-process oracle's bytes (tier-1
+    # via tests/test_bench_budget)
+    try:
+        el = elastic_leg(dryrun=True)
+        missing = [k for k in ELASTIC_SCHEMA_KEYS if k not in el]
+        line.update(el)
+        line["elastic_ok"] = bool(
+            not missing
+            and el["elastic_identity_ok"]
+            and el["elastic_recovery_ok"]
+            and el["elastic_wall_s"] > 0)
+        if missing:
+            line["elastic_schema_missing"] = missing
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["elastic_ok"] = False
+        line["elastic_leg"] = f"failed: {type(exc).__name__}: {exc}"
     # device-time attribution gate (ISSUE 10): the REAL leg at toy
     # shape on CPU — windowed capture, parse, schema — with the
     # acceptance floor: >=90% of captured device time attributes to
@@ -1980,6 +2100,21 @@ def main():
                     and stleg.get("stream_resume_ok")):
                 auc_ok = False
         _checkpoint("aux-stream")
+
+    # elastic chaos (ISSUE 16): the rank-failure recovery gate for
+    # record — SIGKILL a worker mid-window, shrink to world 1, regrow
+    # with a replacement, and demand the uninterrupted oracle's bytes
+    # back.  Gate-bearing: a recovery that diverges must not keep the
+    # headline green.
+    if os.environ.get("BENCH_ELASTIC", "1") != "0":
+        eleg = _leg(line, "elastic", lambda: elastic_leg(line),
+                    gate=True)
+        if eleg is not None:
+            line.update(eleg)
+            if not (eleg.get("elastic_identity_ok")
+                    and eleg.get("elastic_recovery_ok")):
+                auc_ok = False
+        _checkpoint("aux-elastic")
 
     # 255-bin leg (VERDICT r4 #7): the EXACT docs/Experiments.rst:104-116
     # bin/leaf config (max_bin=255, 255 leaves) at reduced iterations, so

@@ -24,7 +24,7 @@ __all__ = [
     "reset_parameter", "EarlyStopException", "telemetry",
     "LGBMModel", "LGBMRegressor", "LGBMClassifier", "LGBMRanker",
     "plot_importance", "plot_metric", "plot_tree",
-    "train_streaming", "outofcore",
+    "train_streaming", "train_elastic", "outofcore",
 ]
 
 
@@ -43,6 +43,9 @@ def __getattr__(name):
         # lazy: the out-of-core trainer pulls in the learner stack
         from .boosting.streaming import train_streaming as _ts
         return _ts
+    if name == "train_elastic":
+        from .boosting.streaming import train_elastic as _te
+        return _te
     if name == "outofcore":
         from .io import outofcore as _oc
         return _oc

@@ -1950,13 +1950,43 @@ class GBDT:
     def save_snapshot(self, iteration: Optional[int] = None) -> Optional[str]:
         """Write an atomic snapshot (model + f32 score state + manifest)
         and prune to ``snapshot_keep`` (see ``boosting/snapshot.py``).
-        Multi-process: rank 0 writes (every rank holds the identical
-        model; a shared filesystem would race otherwise)."""
-        if jax.process_count() > 1 and jax.process_index() != 0:
-            return None
+
+        Multi-process: rank 0 writes (every rank used to race the same
+        path), under a cross-rank COMMIT BARRIER — ranks first publish
+        ``(iteration, model_digest)`` over the host collective and the
+        write proceeds only when every rank reports the same pair (a
+        desynced mesh must not commit a snapshot that only rank 0's
+        model matches); a second collective after the write keeps
+        non-zero ranks from racing past an uncommitted manifest."""
+        it = self.iter if iteration is None else iteration
+        if jax.process_count() > 1:
+            from ..io.distributed import jax_process_allgather
+            return self._snapshot_barrier(it, jax_process_allgather,
+                                          jax.process_index())
         from .snapshot import write_snapshot
-        return write_snapshot(self, self.iter if iteration is None
-                              else iteration)
+        return write_snapshot(self, it)
+
+    def _snapshot_barrier(self, iteration: int, allgather,
+                          rank: int) -> Optional[str]:
+        """The commit-barrier protocol, parameterized over the
+        collective so tier-1 pins it in-process (ThreadedAllgather)."""
+        from ..obs import event
+        from .snapshot import write_snapshot
+        d = self.digest(include_scores=False)
+        acks = allgather({"iteration": int(iteration), "digest": d})
+        if any(a != acks[0] for a in acks[1:]):
+            event("elastic", "barrier_mismatch", iteration=int(iteration),
+                  acks=len(acks))
+            raise RuntimeError(
+                f"snapshot commit barrier at iteration {iteration} "
+                f"refused: ranks disagree on (iteration, digest): {acks}")
+        path = None
+        if rank == 0:
+            path = write_snapshot(self, iteration)
+        # commit confirmation: no rank proceeds (or treats the snapshot
+        # as durable) until rank 0's manifest is on disk
+        allgather({"committed": int(iteration)})
+        return path
 
     def resume_from_snapshot(self, path_or_dir: str) -> int:
         """Restore trees, scores, and early-stopping state from the
@@ -1989,6 +2019,23 @@ class GBDT:
             log_warning("resuming with a DIFFERENT config than the "
                         "snapshot was written with; the continued run "
                         "will not match an uninterrupted one")
+        # world-size-sensitive fields must MATCH the live mesh: a
+        # 2-process snapshot resumed on 1 process (or vice versa) has a
+        # different score layout and row sharding — refuse instead of
+        # silently training on (older manifests lack the field: warn)
+        snap_world = manifest.get("world_size")
+        live_world = jax.process_count()
+        if snap_world is None:
+            if live_world > 1:
+                log_warning("snapshot manifest predates world-size "
+                            "tracking; cannot verify it matches this "
+                            f"{live_world}-process mesh")
+        elif int(snap_world) != live_world:
+            raise ValueError(
+                f"cannot resume: snapshot was written on a "
+                f"{int(snap_world)}-process mesh, this run has "
+                f"{live_world} process(es); re-shard via elastic "
+                f"training (parallel/elastic.py) or restart training")
 
         from ..utils.file_io import open_read
         with open_read(manifest["model_path"]) as f:

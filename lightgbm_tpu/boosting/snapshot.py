@@ -51,6 +51,7 @@ from ..utils.log import log_info, log_warning
 
 MANIFEST_VERSION = 1
 _SNAP_RE = re.compile(r"\.snapshot_iter_(\d+)\.manifest\.json$")
+_BARRIER_RE = re.compile(r"\.barrier_iter_(\d+)\.manifest\.json$")
 
 
 def snapshot_paths(prefix: str, iteration: int) -> Tuple[str, str, str]:
@@ -121,9 +122,13 @@ def write_snapshot(gbdt, iteration: int, prefix: Optional[str] = None,
             atomic_write(state_path, buf.getvalue(), binary=True)
 
         es = getattr(gbdt, "_es_state", None) or {}
+        import jax
         manifest = {
             "version": MANIFEST_VERSION,
             "iteration": int(iteration),
+            # world-size-sensitive: resume on a different mesh size must
+            # refuse (the score layout and row sharding would not match)
+            "world_size": int(jax.process_count()),
             "num_trees": int(gbdt.num_trees()),
             "num_tree_per_iteration": int(max(1, gbdt.num_tree_per_iteration)),
             "init_score_value": float(gbdt.init_score_value),
@@ -251,6 +256,180 @@ def prune_snapshots(prefix: str, keep: int) -> None:
         for path in (base, base + ".state.npz", manifest_path,
                      base + ".tmp", base + ".state.npz.tmp",
                      manifest_path + ".tmp"):
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# barrier snapshots (elastic training — parallel/elastic.py)
+# ---------------------------------------------------------------------------
+# Layout (the snapshot discipline, sharded):
+#
+#     <prefix>.barrier_iter_<N>              model text        (rank 0)
+#     <prefix>.barrier_iter_<N>.shard<k>.npz shard k f32 scores (owner)
+#     <prefix>.barrier_iter_<N>.manifest.json commit marker     (rank 0,
+#                                             written LAST)
+#
+# Every rank writes its owned shards' score state, the ranks allgather
+# (iteration, model_digest, shard shas) — a barrier COMMITS only when
+# every rank published the same (iteration, digest) — and rank 0 writes
+# the model text and then the manifest.  Because the manifest carries
+# every shard's sha and only appears after all shard files exist, a
+# SIGKILL anywhere in the sequence leaves either a complete barrier or
+# a torn one that validation skips (recovery lands on the previous
+# committed barrier, never a torn one).
+
+def barrier_paths(prefix: str, iteration: int) -> Tuple[str, str]:
+    base = f"{prefix}.barrier_iter_{iteration}"
+    return base, base + ".manifest.json"
+
+
+def barrier_shard_path(prefix: str, iteration: int, shard: int) -> str:
+    return f"{prefix}.barrier_iter_{iteration}.shard{shard}.npz"
+
+
+def write_barrier_shard(prefix: str, iteration: int, shard: int,
+                        scores: np.ndarray) -> str:
+    """Publish one shard's f32 score rows for a pending barrier;
+    returns the payload sha256 (the commit allgather carries it into
+    rank 0's manifest)."""
+    import io as _io
+    buf = _io.BytesIO()
+    np.savez(buf, scores=np.asarray(scores, np.float32))
+    payload = buf.getvalue()
+    atomic_write(barrier_shard_path(prefix, iteration, shard), payload,
+                 binary=True)
+    counter_add("snapshot.barrier_shards")
+    return _sha256_bytes(payload)
+
+
+def commit_barrier(prefix: str, iteration: int, model_text: str,
+                   shard_shas: Dict[int, str], meta: Dict,
+                   keep: int = 2) -> str:
+    """Rank 0's half of the barrier commit: model text, then the
+    manifest LAST (its appearance is the global commit marker — it
+    names every shard file's sha, all of which exist by now: the
+    commit allgather collected them from their writers)."""
+    model_path, manifest_path = barrier_paths(prefix, iteration)
+    with span("snapshot.barrier", iteration=int(iteration)) as sp:
+        atomic_write(model_path, model_text, chunks=2)
+        manifest = {
+            "version": MANIFEST_VERSION,
+            "kind": "barrier",
+            "iteration": int(iteration),
+            "model_file": os.path.basename(model_path),
+            "model_size": len(model_text.encode()),
+            "model_sha256": _sha256_bytes(model_text.encode()),
+            "shards": {str(s): sha
+                       for s, sha in sorted(shard_shas.items())},
+            **meta,
+        }
+        atomic_write(manifest_path, json.dumps(manifest, indent=1))
+        sp["bytes"] = manifest["model_size"]
+        counter_add("snapshot.barrier_commits")
+    log_info(f"committed barrier snapshot at iteration {iteration} "
+             f"({len(shard_shas)} shards): {model_path}")
+    prune_barriers(prefix, keep)
+    return model_path
+
+
+def list_barriers(prefix: str) -> List[Tuple[int, str]]:
+    """All barrier manifests for a prefix, ``(iteration, path)``
+    newest-first."""
+    directory = os.path.dirname(prefix) or "."
+    stem = os.path.basename(prefix)
+    out = []
+    try:
+        names = os.listdir(directory)
+    except OSError:
+        return []
+    for name in names:
+        m = _BARRIER_RE.search(name)
+        if m is None or not name.startswith(stem + ".barrier_iter_"):
+            continue
+        out.append((int(m.group(1)), os.path.join(directory, name)))
+    out.sort(key=lambda t: -t[0])
+    return out
+
+
+def validate_barrier(manifest_path: str) -> Optional[Dict]:
+    """Parse + verify one barrier: manifest, model text, and EVERY
+    shard state file against its recorded sha256.  None when anything
+    is missing or torn — a barrier is all-or-nothing."""
+    with span("snapshot.validate"):
+        try:
+            with open(manifest_path) as f:
+                manifest = json.load(f)
+        except (OSError, ValueError):
+            return None
+        directory = os.path.dirname(manifest_path) or "."
+        model_path = os.path.join(directory,
+                                  manifest.get("model_file", ""))
+        try:
+            if os.path.getsize(model_path) != manifest["model_size"]:
+                return None
+            if _sha256_file(model_path) != manifest["model_sha256"]:
+                return None
+        except (OSError, KeyError):
+            return None
+        base = manifest_path[:-len(".manifest.json")]
+        shard_paths = {}
+        for s, sha in manifest.get("shards", {}).items():
+            path = f"{base}.shard{int(s)}.npz"
+            try:
+                if _sha256_file(path) != sha:
+                    return None
+            except OSError:
+                return None
+            shard_paths[int(s)] = path
+        manifest["model_path"] = model_path
+        manifest["shard_paths"] = shard_paths
+        return manifest
+
+
+def latest_valid_barrier(prefix: str,
+                         num_shards: Optional[int] = None) -> Optional[Dict]:
+    """Newest barrier that validates in full (and matches the
+    protocol shard count when given — a barrier from a different
+    protocol is a different identity domain, never silently resumed)."""
+    for it, manifest_path in list_barriers(prefix):
+        manifest = validate_barrier(manifest_path)
+        if manifest is None:
+            log_warning(f"barrier snapshot at iteration {it} is torn "
+                        f"({manifest_path}); trying the previous one")
+            continue
+        if num_shards is not None \
+                and int(manifest.get("num_shards", -1)) != int(num_shards):
+            log_warning(
+                f"barrier snapshot at iteration {it} was written for "
+                f"{manifest.get('num_shards')} protocol shards, this "
+                f"run uses {num_shards}; skipping it")
+            continue
+        return manifest
+    return None
+
+
+def prune_barriers(prefix: str, keep: int) -> None:
+    """Keep the newest ``keep`` COMMITTED barriers (same retention
+    rationale as :func:`prune_snapshots`); uncommitted shard residue of
+    pruned iterations goes with them."""
+    if keep <= 0:
+        return
+    directory = os.path.dirname(prefix) or "."
+    for it, manifest_path in list_barriers(prefix)[keep:]:
+        base = manifest_path[:-len(".manifest.json")]
+        victims = [base, manifest_path, base + ".tmp",
+                   manifest_path + ".tmp"]
+        try:
+            for name in os.listdir(directory):
+                full = os.path.join(directory, name)
+                if full.startswith(base + ".shard"):
+                    victims.append(full)
+        except OSError:
+            pass
+        for path in victims:
             try:
                 os.unlink(path)
             except OSError:

@@ -50,9 +50,28 @@ and data-parallel layouts.  Documented descopes (they raise):
 bagging/GOSS (the [n]-shaped device mask breaks the memory contract),
 DART (host score patching), ranking (row blocks would split queries),
 custom ``fobj``, leaf-renewal objectives, valid sets / early stopping.
+
+**Elastic training** (:func:`train_elastic`) rides this trainer because
+ALL of its cross-shard communication is explicit host-side combination
+of per-shard partials — unlike the in-memory mesh path, whose psum
+lives inside an XLA dispatch that cannot be cancelled when a peer
+dies.  The protocol fixes a shard count ``S`` for the run's lifetime
+(``LGBM_TPU_ELASTIC_SHARDS``; default = the initial world size); each
+rank owns shards ``s % world == rank``, folds their blocks exactly as
+the local ``S``-shard path would, and the per-shard partials are
+allgathered (``parallel/elastic.py``) and combined in SHARD order —
+the identical elementwise adds regardless of which rank computed which
+shard.  Training is therefore a pure function of ``(data, config, S)``:
+any world size, any membership history, and any recovery from a
+committed barrier snapshot produce byte-identical models (the chaos
+gate ``tools/chaos.py`` proves it with real SIGKILLs).  On a
+``RankLostError`` / ``GenerationChanged`` survivors re-rendezvous,
+re-own shards at the new world size, and resume from the last
+committed barrier (``boosting/snapshot.py`` barrier functions).
 """
 from __future__ import annotations
 
+import hashlib
 import os
 from typing import List, Optional, Tuple
 
@@ -68,7 +87,7 @@ from ..learner.serial import (STREAM_CHUNK, BuiltTree, _WaveState,
                               make_hist_fn, reduce_chunk_sums,
                               resolve_backend, root_chunk_sums, scan_grid,
                               stage_plan, uses_pallas)
-from ..obs import counter_add, span as obs_span
+from ..obs import counter_add, event, span as obs_span
 from ..objective.objectives import create_objective
 from ..ops.pallas_histogram import bin_stride
 from ..ops.pallas_route import route_rows_xla
@@ -199,12 +218,23 @@ class StreamTrainer:
     IO, ``digest()``, prediction through the mapper shell) whose train
     scores are the streamed host-resident score state."""
 
-    def __init__(self, config: Config, source, block_rows: int = 0):
+    def __init__(self, config: Config, source, block_rows: int = 0,
+                 num_shards: int = 0, elastic=None):
         self.config = config
         self.src = _Source(source, config)
         self.R = block_rows or stream_rows() or STREAM_CHUNK
         self.R = -(-self.R // STREAM_CHUNK) * STREAM_CHUNK
-        self.S = _num_shards(config)
+        # the protocol shard count: explicit > elastic run > mesh shape.
+        # Under elastic training S is FIXED for the run's lifetime (it
+        # is the identity domain — see the module docstring) while the
+        # world size is not.
+        self.elastic = elastic
+        self.S = (int(num_shards)
+                  or (int(elastic.num_shards) if elastic is not None else 0)
+                  or _num_shards(config))
+        self.owned = (elastic.owned_shards() if elastic is not None
+                      else tuple(range(self.S)))
+        self._owned_set = frozenset(self.owned)
         n = self.src.n
         if n <= 0:
             raise ValueError("empty stream source")
@@ -513,6 +543,16 @@ class StreamTrainer:
                 pos = stop
         return out
 
+    def _my_blocks(self) -> List[Tuple[int, int, int, int]]:
+        """This rank's blocks: under elastic training only the owned
+        shards' blocks are read, folded and score-updated here — every
+        shard has exactly one owner per generation (``s % world``), so
+        the union over ranks is the full block list."""
+        blocks = self._blocks()
+        if self.elastic is None:
+            return blocks
+        return [b for b in blocks if b[0] in self._owned_set]
+
     def _pad_block(self, arr: Optional[np.ndarray], m: int,
                    fill=0) -> Optional[np.ndarray]:
         if arr is None:
@@ -525,11 +565,23 @@ class StreamTrainer:
     # -- training ---------------------------------------------------------
     def train(self, num_iterations: Optional[int] = None) -> GBDT:
         iters = num_iterations or self.config.num_iterations
+        # a restored barrier leaves booster.iter mid-run; continuing
+        # from it keeps the per-iteration seeds (feature_fraction keys
+        # on the TRUE iteration index) on the uninterrupted schedule
+        start = self.booster.iter
         with obs_span("stream.train", rows=self.n, block=self.R,
                       shards=self.S):
-            for it in range(iters):
-                if self._train_one_iter(it):
+            for it in range(start, iters):
+                stopped = self._train_one_iter(it)
+                if stopped:
                     break
+                if self.elastic is not None:
+                    # progress rides the heartbeats: operators (and the
+                    # chaos launcher's kill scheduler) see it in info()
+                    self.elastic.client.set_status(iteration=it + 1)
+                    self._maybe_barrier(it + 1)
+        if self.elastic is not None and self.elastic.world > 1:
+            self._sync_scores()
         self.booster.scores = self.scores     # host state IS the digest
         self.booster.trim_trailing_stumps()
         return self.booster
@@ -538,7 +590,7 @@ class StreamTrainer:
         c = self.config
         K = self.K
         grad_fn = self._grad_fn()
-        blocks = self._blocks()
+        blocks = self._my_blocks()
         n = self.n
         # gradients per block, stored host-side for the tree's waves
         G = np.empty((n, K), np.float32)
@@ -594,7 +646,7 @@ class StreamTrainer:
     def _build_streamed_tree(self, it: int, k: int, grad: np.ndarray,
                              hess: np.ndarray, fmask) -> int:
         L = self.L
-        blocks = self._blocks()
+        blocks = self._my_blocks()
         wave_block = self._wave_block_fn()
         wave_scan = self._wave_scan_fn()
         wave_apply = self._wave_apply_fn()
@@ -624,7 +676,28 @@ class StreamTrainer:
 
         # in-memory chunk grids: serial = ceil(n/C); data-parallel =
         # ceil(per/C) per shard (mesh padding rows are zero chunks)
-        if self.S == 1:
+        exchange = (self.elastic is not None and self.elastic.world > 1)
+        if exchange:
+            # per-shard scalars reduce locally (the same fixed pairwise
+            # tree any owner would run), travel as [3] f32 arrays, and
+            # combine in SHARD order — bitwise what the single-process
+            # S-shard branch below computes
+            m_chunks = -(-self.per // STREAM_CHUNK)
+            payload = {}
+            for s in self.owned:
+                cs = np.concatenate(shard_cs[s], axis=1)
+                if cs.shape[1] < m_chunks:   # trailing mesh-pad chunks
+                    cs = np.concatenate(
+                        [cs, np.zeros((3, m_chunks - cs.shape[1]),
+                                      np.float32)], axis=1)
+                part = jnp.stack(reduce_chunk_sums(
+                    jnp.asarray(cs[:, :m_chunks])))
+                payload[str(s)] = np.asarray(part)
+            merged = self._exchange_arrays(payload)
+            parts = [jnp.asarray(merged[s]) for s in range(self.S)]
+            tot = parts[0] if self.S == 1 else combine(parts)
+            state = init_state(tot[:, None])   # [3, 1]: identity reduce
+        elif self.S == 1:
             m_chunks = -(-self.n // STREAM_CHUNK)
             cs_all = np.concatenate(shard_cs[0], axis=1)[:, :m_chunks]
             state = init_state(jnp.asarray(cs_all))
@@ -657,7 +730,17 @@ class StreamTrainer:
                     state.act_small)
                 leaf2_host[bi] = np.asarray(l2)
                 accs[s] = acc
-            new_h = accs[0] if self.S == 1 else combine(accs)
+            if exchange:
+                # per-shard wave partials are rank-independent (each
+                # shard's carried fold is the same program any owner
+                # runs); combining the gathered partials in shard order
+                # IS the single-process combine below, bitwise
+                merged = self._exchange_arrays(
+                    {str(s): np.asarray(accs[s]) for s in self.owned})
+                parts = [jnp.asarray(merged[s]) for s in range(self.S)]
+                new_h = parts[0] if self.S == 1 else combine(parts)
+            else:
+                new_h = accs[0] if self.S == 1 else combine(accs)
             hist_state, ids, res = wave_scan(state, new_h, fmask)
             state = wave_apply(state, hist_state, ids, res)
             counter_add("stream.waves")
@@ -696,6 +779,155 @@ class StreamTrainer:
         counter_add("stream.trees")
         return int(nl)
 
+    # -- elastic protocol -------------------------------------------------
+    def _exchange_arrays(self, payload) -> dict:
+        """Allgather ``{shard: array}`` contributions and return the
+        full ``{shard: array}`` map — every protocol shard must be
+        covered (the mod-world ownership rule guarantees it; a hole
+        means a protocol desync, not a recoverable fault)."""
+        from ..parallel.elastic import decode_array, encode_array
+        gathered = self.elastic.allgather(
+            {s: encode_array(a) for s, a in payload.items()})
+        merged = {}
+        for part in gathered:
+            merged.update(part or {})
+        out = {}
+        for s in range(self.S):
+            enc = merged.get(str(s))
+            if enc is None:
+                raise RuntimeError(
+                    f"elastic exchange is missing shard {s} of {self.S} "
+                    f"(world {self.elastic.world}): ranks disagree on "
+                    "the shard protocol")
+            out[s] = decode_array(enc)
+        return out
+
+    def _maybe_barrier(self, iteration: int) -> None:
+        freq = int(self.config.snapshot_freq or 0)
+        if freq <= 0 or iteration % freq != 0:
+            return
+        self._barrier_snapshot(iteration)
+
+    def _barrier_snapshot(self, iteration: int) -> None:
+        """The coordinated snapshot commit: shard states first, then a
+        commit allgather of ``(iteration, model digest, shard shas)``
+        that every rank must match, then rank 0 publishes model text +
+        manifest (manifest LAST — its appearance is the global commit
+        marker).  A SIGKILL anywhere in this sequence leaves either a
+        complete barrier or a torn one that validation skips."""
+        from .snapshot import commit_barrier, config_hash, \
+            write_barrier_shard
+        run = self.elastic
+        prefix = self.config.output_model
+        shard_shas = {}
+        for s in self.owned:
+            lo, hi = self.ranges[s]
+            hi = min(hi, self.n)
+            shard_shas[s] = write_barrier_shard(
+                prefix, iteration, s, self.scores[lo:hi])
+        model_text = self.booster.save_model_to_string(-1)
+        digest = hashlib.sha256(model_text.encode()).hexdigest()
+        acks = run.allgather({
+            "iteration": int(iteration), "digest": digest,
+            "shards": {str(s): sha for s, sha in shard_shas.items()}})
+        head = (acks[0]["iteration"], acks[0]["digest"])
+        for a in acks[1:]:
+            if (a["iteration"], a["digest"]) != head:
+                event("elastic", "barrier_mismatch",
+                      iteration=int(iteration))
+                raise RuntimeError(
+                    f"barrier commit mismatch at iteration {iteration}: "
+                    f"ranks disagree on (iteration, model digest) "
+                    f"{[(a['iteration'], a['digest'][:12]) for a in acks]}"
+                    " — refusing to publish a snapshot that is not "
+                    "globally valid")
+        if run.rank == 0:
+            merged = {}
+            for a in acks:
+                merged.update({int(s): sha
+                               for s, sha in a["shards"].items()})
+            meta = {
+                "num_shards": int(self.S),
+                "world_size": int(run.world),
+                "generation": int(run.generation),
+                "config_hash": config_hash(self.config),
+                "init_score_value": float(self.booster.init_score_value),
+                "num_tree_per_iteration": int(self.K),
+            }
+            commit_barrier(prefix, iteration, model_text, merged, meta,
+                           keep=max(int(self.config.snapshot_keep), 1))
+        # all ranks outlive the publish: a rank that raced ahead into
+        # the next window could otherwise observe a half-written commit
+        run.barrier(f"barrier-committed-{iteration}")
+        counter_add("elastic.barriers")
+
+    def restore_barrier(self, prefix: Optional[str] = None) -> int:
+        """Adopt the newest COMMITTED barrier under ``prefix`` (trees
+        from the model text, scores from the shard state files); returns
+        the restored iteration, 0 when there is nothing to restore.
+        Rank-oblivious by construction: every rank reads the same
+        manifest, and shard states are keyed by protocol shard, not by
+        the rank that wrote them."""
+        from .snapshot import config_hash, latest_valid_barrier
+        prefix = prefix or self.config.output_model
+        man = latest_valid_barrier(prefix, num_shards=self.S)
+        if man is None:
+            return 0
+        if man.get("config_hash") and \
+                man["config_hash"] != config_hash(self.config):
+            raise ValueError(
+                "cannot resume from barrier snapshot: the training "
+                "config changed (it would train a different model under "
+                "the same prefix); clear the barrier files or keep the "
+                "config")
+        if int(man.get("num_tree_per_iteration", self.K)) != self.K:
+            raise ValueError("barrier snapshot objective shape does not "
+                             "match this run")
+        with open(man["model_path"]) as f:
+            donor = GBDT(self.config, None)
+            donor.load_model_from_string(f.read())
+        light = self.booster.train_set
+        fmap = {f: i for i, f in enumerate(light.used_features)}
+        for t in donor.models:
+            t.align_with_mappers(light.mappers, fmap)
+        self.booster.models = list(donor.models)
+        self.booster._pending = []
+        self.booster._stacked_cache = None
+        self.booster.iter = int(man["iteration"])
+        self.booster.init_score_value = float(
+            man.get("init_score_value", self.booster.init_score_value))
+        for s, path in man["shard_paths"].items():
+            lo, hi = self.ranges[int(s)]
+            hi = min(hi, self.n)
+            arr = np.load(path)["scores"]
+            if arr.shape != (hi - lo, self.K):
+                raise ValueError(
+                    f"barrier shard {s} carries scores of shape "
+                    f"{arr.shape}, expected {(hi - lo, self.K)} — the "
+                    "data or shard protocol changed under the prefix")
+            self.scores[lo:hi] = arr
+        counter_add("snapshot.barrier_resumes")
+        log_info(f"restored barrier snapshot: iteration "
+                 f"{self.booster.iter}, {len(man['shard_paths'])} shard "
+                 f"states ({prefix})")
+        return self.booster.iter
+
+    def _sync_scores(self) -> None:
+        """Train-end score replication: every rank gathers the shards
+        it does not own, so the returned booster's ``digest()`` is the
+        full-dataset digest on every rank (the identity the chaos gate
+        compares)."""
+        payload = {}
+        for s in self.owned:
+            lo, hi = self.ranges[s]
+            hi = min(hi, self.n)
+            payload[str(s)] = self.scores[lo:hi]
+        merged = self._exchange_arrays(payload)
+        for s in range(self.S):
+            lo, hi = self.ranges[s]
+            hi = min(hi, self.n)
+            self.scores[lo:hi] = merged[s]
+
 
 def train_streaming(params, source, num_boost_round: Optional[int] = None,
                     cache_dir: Optional[str] = None,
@@ -713,3 +945,109 @@ def train_streaming(params, source, num_boost_round: Optional[int] = None,
         source = ingest(list(source), config, cdir)
     trainer = StreamTrainer(config, source, block_rows=block_rows)
     return trainer.train(num_boost_round)
+
+
+def elastic_shards(world: int, explicit: int = 0) -> int:
+    """The run-lifetime protocol shard count: explicit argument >
+    ``LGBM_TPU_ELASTIC_SHARDS`` > the initial world size.  Fixing S
+    while the world varies is what makes every membership history land
+    on the same bytes (the model is a function of ``(data, config, S)``,
+    never of who computed which shard)."""
+    s = int(explicit) or int(os.environ.get("LGBM_TPU_ELASTIC_SHARDS",
+                                            "0") or 0)
+    return s if s > 0 else max(int(world), 1)
+
+
+def train_elastic(params, source, num_boost_round: Optional[int] = None,
+                  coordinator: Optional[str] = None,
+                  cache_dir: Optional[str] = None, block_rows: int = 0,
+                  num_shards: int = 0, min_world: int = 1,
+                  client=None, max_recoveries: int = 64) -> GBDT:
+    """Train under the elastic protocol (``parallel/elastic.py``):
+    rendezvous with the coordinator, stream-train the owned shard
+    slice, commit cross-rank barrier snapshots every ``snapshot_freq``
+    iterations, and on ANY elastic interrupt (lost rank, membership
+    change, eviction) re-rendezvous at the new world size, re-shard,
+    and resume from the last committed barrier.  The recovered model is
+    byte-identical to the uninterrupted run at any world size
+    (``tools/chaos.py`` is the gate).
+
+    ``source`` follows :func:`train_streaming` (every member must see
+    the same data and params — the protocol-agreement allgather checks
+    the config hash).  ``coordinator`` defaults to ``LGBM_TPU_ELASTIC``.
+    """
+    from ..config import canonicalize_params
+    from ..io.outofcore import default_cache_dir, ingest
+    from ..obs import health
+    from ..parallel.elastic import (ELASTIC_INTERRUPTS, ElasticClient,
+                                    ElasticRun, EvictedError,
+                                    elastic_address)
+    from .snapshot import config_hash
+    config = Config.from_params(canonicalize_params(dict(params)))
+    config.check()
+    if isinstance(source, (list, tuple)):
+        cdir = cache_dir or default_cache_dir(list(source))
+        source = ingest(list(source), config, cdir)
+    own_client = client is None
+    if client is None:
+        addr = coordinator or elastic_address()
+        if addr is None:
+            raise ValueError(
+                "elastic training needs a coordinator: pass "
+                "coordinator='host:port' or set LGBM_TPU_ELASTIC")
+        client = ElasticClient(addr)
+    try:
+        world, _, _ = client.join_world(min_world=min_world)
+        S = elastic_shards(world, num_shards)
+        chash = config_hash(config)
+        recoveries = 0
+        while True:
+            try:
+                run = ElasticRun(client, S)
+                # protocol agreement before any work: every member of
+                # this generation must train the same config with the
+                # same shard count, or the partials are meaningless
+                views = run.allgather({"shards": S, "config": chash})
+                for v in views[1:]:
+                    if v != views[0]:
+                        raise RuntimeError(
+                            "elastic members disagree on the protocol "
+                            f"({views}); every member must train the "
+                            "same params with the same shard count")
+                with obs_span("elastic.reshard", world=run.world,
+                              generation=run.generation, shards=S):
+                    trainer = StreamTrainer(config, source,
+                                            block_rows=block_rows,
+                                            num_shards=S, elastic=run)
+                    it0 = trainer.restore_barrier()
+                if it0:
+                    log_info(f"elastic: resuming from barrier iteration "
+                             f"{it0} as rank {run.rank}/{run.world} "
+                             f"(generation {run.generation})")
+                health.mark_ready()
+                return trainer.train(num_boost_round)
+            except ELASTIC_INTERRUPTS as exc:
+                recoveries += 1
+                if recoveries > max_recoveries:
+                    raise
+                counter_add("elastic.recoveries")
+                health.mark_recovering(reason=type(exc).__name__)
+                with obs_span("elastic.recover",
+                              error=type(exc).__name__):
+                    event("elastic", "recover", error=type(exc).__name__,
+                          generation=int(client.generation))
+                    if isinstance(exc, EvictedError):
+                        # evicted members come back as fresh members
+                        client.join_world(min_world=1)
+                    else:
+                        try:
+                            client.resync()
+                        except ELASTIC_INTERRUPTS:
+                            client.join_world(min_world=1)
+                continue
+    finally:
+        if own_client:
+            try:
+                client.leave()
+            finally:
+                client.close()

@@ -22,7 +22,9 @@ external collectives (`LGBM_NetworkInitWithFunctions`, `c_api.h:760`):
 from __future__ import annotations
 
 import json
+import os
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -32,6 +34,91 @@ from .binning import BIN_CATEGORICAL, BIN_NUMERICAL, BinMapper
 
 # allgather: (obj) -> list of every rank's obj, rank-ordered
 AllgatherFn = Callable[[object], List[object]]
+
+
+class RankLostError(RuntimeError):
+    """A host collective blew its deadline: some rank stopped
+    participating (dead, or wedged past ``LGBM_TPU_COLLECTIVE_DEADLINE_S``).
+    Typed so the elastic recovery loop (``parallel/elastic.py``) can
+    re-rendezvous instead of the whole job blocking forever — the
+    failure mode both the reference and PR 1-13 still had.  NOT
+    transient for the retry layer: retrying into the same dead world
+    just burns another deadline."""
+
+    def __init__(self, site: str, deadline_s: float, detail: str = ""):
+        self.site = site
+        self.deadline_s = float(deadline_s)
+        msg = (f"collective {site!r} exceeded its {deadline_s:g}s "
+               f"deadline; a rank is lost or wedged")
+        if detail:
+            msg += f" ({detail})"
+        super().__init__(msg)
+
+
+def collective_deadline_s() -> Optional[float]:
+    """The host-collective deadline from ``LGBM_TPU_COLLECTIVE_DEADLINE_S``
+    (seconds; unset/non-positive = block forever, the pre-elastic
+    behavior)."""
+    raw = os.environ.get("LGBM_TPU_COLLECTIVE_DEADLINE_S", "")
+    if not raw:
+        return None
+    try:
+        s = float(raw)
+    except ValueError:
+        return None
+    return s if s > 0 else None
+
+
+def deadline_call(fn: Callable, site: str,
+                  deadline: Optional[float] = None):
+    """Run ``fn()`` under the collective deadline: the call executes in
+    a worker thread and a result must land within ``deadline`` seconds
+    or a typed :class:`RankLostError` is raised (the blocked thread is
+    daemonic and abandoned — a wedged DCN op cannot be cancelled from
+    Python, but the caller gets control back to re-rendezvous).
+
+    The ``collective.hang`` fault point fires here as a *silent* sleep
+    past the deadline (``utils/faults.fault_flag``) — it exercises
+    detection (the deadline path), unlike ``collective.allgather`` which
+    raises and exercises retry.  With no deadline configured the call
+    runs inline, zero overhead."""
+    from ..utils.faults import fault_flag
+    if deadline is None:
+        deadline = collective_deadline_s()
+    hang = fault_flag("collective.hang")
+    if deadline is None:
+        if hang:
+            time.sleep(0.05)        # armed but undeadlined: token stall
+        return fn()
+    done = threading.Event()
+    box: dict = {}
+
+    def run():
+        if hang:
+            # sleep PAST the deadline, then still complete: the caller
+            # must already have raised — detection, not data loss
+            time.sleep(deadline * 1.5 + 0.05)
+        try:
+            box["value"] = fn()
+        # tpulint: disable=TPL006 -- not swallowed: the caller re-raises
+        # box["error"] after done.wait() (unless the deadline already
+        # fired, in which case RankLostError preempted this result)
+        except BaseException as exc:    # noqa: BLE001
+            box["error"] = exc
+        finally:
+            done.set()
+
+    t = threading.Thread(target=run, name=f"lgbm-tpu-collective-{site}",
+                         daemon=True)
+    t.start()
+    if not done.wait(deadline):
+        from ..obs import counter_add, event
+        counter_add("collective.deadline_exceeded")
+        event("elastic", "rank_lost", site=site, deadline_s=deadline)
+        raise RankLostError(site, deadline)
+    if "error" in box:
+        raise box["error"]
+    return box["value"]
 
 
 class ThreadedAllgather:
@@ -90,8 +177,12 @@ def jax_process_allgather(obj) -> List[object]:
     fr_record("io.distributed.jax_process_allgather", "process_allgather")
     # span around the WHOLE retried call: collective wall-clock in the
     # run summary includes retries + backoff (what the run actually paid)
+    # — under the deadline (RankLostError is not transient, so it cuts
+    # through the retry policy instead of burning deadline x attempts)
     with span("collective.allgather"):
-        return retry_call(_gather, what="collective.allgather")
+        return deadline_call(
+            lambda: retry_call(_gather, what="collective.allgather"),
+            "io.distributed.jax_process_allgather")
 
 
 class ExternalCollectives:
@@ -211,7 +302,8 @@ def find_bins_distributed(X_local: np.ndarray,
     def allgather(obj):
         fr_record("io.distributed.binfind_allgather", "allgather")
         with span("collective.binfind"):
-            return _retry_ag(obj)
+            return deadline_call(lambda: _retry_ag(obj),
+                                 "io.distributed.binfind_allgather")
     cat_set = set(int(c) for c in categorical_features)
     # 1. sync feature count to the min across ranks (:821)
     counts = allgather(int(X_local.shape[1]))

@@ -55,8 +55,8 @@ from typing import Any, Callable, Dict, List, Optional
 import numpy as np
 
 __all__ = [
-    "state", "tracking", "mark_warming", "mark_ready", "mark_draining",
-    "mark_degraded", "mark_stalled", "reset", "Watchdog",
+    "state", "tracking", "mark_warming", "mark_ready", "mark_recovering",
+    "mark_draining", "mark_degraded", "mark_stalled", "reset", "Watchdog",
     "watchdog_seconds", "stall_fault", "sentinels_enabled",
     "check_scores", "check_metrics", "forensic_path", "write_forensic",
 ]
@@ -66,8 +66,11 @@ _active = False                  # flipped by the ops plane / watchdog /
 #                                  sentinels: mark_* are no-ops otherwise
 # ordered by severity: a transition may only move DOWN this list via
 # explicit reset (stalled/degraded are sticky — a scraper that polls
-# after the incident must still see it)
-_SEVERITY = ("ready", "warming", "draining", "degraded", "stalled")
+# after the incident must still see it).  `recovering` (elastic
+# re-rendezvous in progress, parallel/elastic.py) is NOT sticky: a
+# successful recovery walks ready -> recovering -> ready.
+_SEVERITY = ("ready", "warming", "recovering", "draining", "degraded",
+             "stalled")
 _state: Dict[str, Any] = {"state": "disabled", "since": None, "detail": {}}
 # sentinel memory: per-metric best (rolling reference for the spike
 # check) and the one-shot flags so a poisoned run reports the FIRST
@@ -128,6 +131,16 @@ def mark_ready() -> None:
     if not _active:
         return
     _transition("ready")
+
+
+def mark_recovering(**detail) -> None:
+    """Elastic recovery in flight (rank lost / membership changed —
+    ``parallel/elastic.py``): survivors are re-rendezvousing and
+    resuming from the last committed barrier snapshot.  Non-sticky —
+    a completed recovery returns ``/healthz`` to ``ready``."""
+    if not _active:
+        return
+    _transition("recovering", **detail)
 
 
 def mark_draining(**detail) -> None:

@@ -1,0 +1,613 @@
+"""Elastic rendezvous: generation-numbered membership + host collectives.
+
+The reference's YARN application master hands every worker the live
+machine list once (``linkers_socket.cpp:27-68``) and never updates it —
+a dead rank hangs the first collective forever and the job dies with
+its snapshots unused.  This module redoes that machine-list loop as a
+restartable *epoch protocol*:
+
+* **Generations** — the coordinator numbers every membership view.
+  Each (re)join returns ``(world_size, rank, generation)``; ANY
+  membership change (join, clean leave, heartbeat eviction) bumps the
+  generation and fails every in-flight and future collective of the
+  old generation with :class:`GenerationChanged` — survivors unwind to
+  the recovery loop (``boosting/streaming.train_elastic``) instead of
+  deadlocking against a member that no longer exists.
+* **Rank-failure detection** — two complementary signals.  Peer
+  heartbeats (interval ``LGBM_TPU_HEARTBEAT_S``) carry the rank's live
+  health state from the PR 13 plane (``obs/health.py``); the
+  coordinator evicts a member only when its heartbeats STOP — a rank
+  whose watchdog reports ``stalled`` but whose heartbeat thread is
+  alive is wedged-but-alive and is deliberately NOT evicted (killing a
+  wedged XLA dispatch's process is the operator's call, not the
+  protocol's).  Independently, every client collective is bounded by
+  ``LGBM_TPU_COLLECTIVE_DEADLINE_S`` and raises the typed
+  :class:`~lightgbm_tpu.io.distributed.RankLostError` instead of
+  blocking forever — the backstop for a dead *coordinator* or an
+  eviction that lands slower than the deadline.
+* **Rank-ordered collectives** — ``allgather`` is the only primitive
+  (barriers are allgathers of a tag).  Contributions are keyed
+  ``(generation, seq)``; payloads return in rank order, so the
+  streamed trainer can combine per-shard partials in *shard* order —
+  the partition-invariant fold that makes recovery byte-identical.
+
+Transport is one JSON line per request over loopback/DCN TCP (the
+reference's own linker transport class); numpy payloads ride base64
+``.npy`` bytes (:func:`encode_array`).  The module is deliberately
+jax-free: protocol tests run without a device runtime.
+
+Fault points (``utils/faults.py``): ``rendezvous.drop_rank`` makes the
+coordinator's monitor evict the newest member (a lost rank without
+killing a process), ``heartbeat.miss`` makes a client skip beats,
+``collective.hang`` (in ``io/distributed.deadline_call``) stalls a
+collective past the deadline.
+"""
+from __future__ import annotations
+
+import base64
+import io
+import json
+import os
+import socket
+import socketserver
+import threading
+import time
+import uuid
+from typing import Any, Dict, List, Optional, Tuple
+
+import numpy as np
+
+from ..io.distributed import RankLostError, collective_deadline_s
+from ..obs import counter_add, event, span
+from ..utils.log import log_info, log_warning
+
+__all__ = [
+    "ElasticCoordinator", "ElasticClient", "ElasticRun",
+    "GenerationChanged", "RankLostError", "ELASTIC_INTERRUPTS",
+    "heartbeat_s", "elastic_address", "encode_array", "decode_array",
+]
+
+
+class GenerationChanged(RuntimeError):
+    """The membership changed under an in-flight collective: the old
+    generation's world no longer exists.  Survivors re-rendezvous and
+    resume from the last committed barrier snapshot."""
+
+    def __init__(self, generation: int, detail: str = ""):
+        self.generation = int(generation)
+        msg = (f"elastic membership changed (now generation "
+               f"{generation}); in-flight collectives are invalid")
+        if detail:
+            msg += f": {detail}"
+        super().__init__(msg)
+
+
+class EvictedError(RuntimeError):
+    """This member was evicted (missed heartbeats); it must re-join as
+    a fresh member to participate again."""
+
+
+# what the recovery loop catches: lost peers, lost epochs.  (Evicted
+# members also recover — by re-joining as a new member.)
+ELASTIC_INTERRUPTS = (RankLostError, GenerationChanged, EvictedError)
+
+
+def heartbeat_s() -> float:
+    """Heartbeat interval from ``LGBM_TPU_HEARTBEAT_S`` (default 0.5 s;
+    eviction timeout defaults to 5 intervals, coordinator-side)."""
+    try:
+        s = float(os.environ.get("LGBM_TPU_HEARTBEAT_S", "0.5"))
+    except ValueError:
+        return 0.5
+    return s if s > 0 else 0.5
+
+
+def elastic_address() -> Optional[str]:
+    """``LGBM_TPU_ELASTIC`` — the coordinator's ``host:port``.  Doubles
+    as the elastic on/off switch: unset means classic fixed-world
+    training."""
+    return os.environ.get("LGBM_TPU_ELASTIC") or None
+
+
+def encode_array(arr: np.ndarray) -> str:
+    """numpy array -> base64 ``.npy`` bytes (dtype+shape travel with
+    the payload; bitwise round-trip)."""
+    buf = io.BytesIO()
+    np.save(buf, np.ascontiguousarray(arr), allow_pickle=False)
+    return base64.b64encode(buf.getvalue()).decode("ascii")
+
+
+def decode_array(text: str) -> np.ndarray:
+    return np.load(io.BytesIO(base64.b64decode(text.encode("ascii"))),
+                   allow_pickle=False)
+
+
+# ---------------------------------------------------------------------------
+# coordinator
+# ---------------------------------------------------------------------------
+class _Member:
+    __slots__ = ("member", "joined_seq", "last", "state", "detail")
+
+    def __init__(self, member: str, joined_seq: int):
+        self.member = member
+        self.joined_seq = joined_seq
+        self.last = time.monotonic()
+        self.state = ""
+        self.detail: Dict[str, Any] = {}
+
+
+class ElasticCoordinator:
+    """The rendezvous + collective server (the YARN-AM analog, run
+    in-process by the launcher — ``tools/chaos.py`` — or standalone).
+
+    One instance serves one training job.  Thread-per-connection; all
+    state under one condition variable.  ``start()`` returns the bound
+    ``host:port`` for ``LGBM_TPU_ELASTIC``."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 heartbeat_timeout_s: Optional[float] = None):
+        self.heartbeat_timeout_s = (heartbeat_timeout_s
+                                    if heartbeat_timeout_s is not None
+                                    else heartbeat_s() * 5)
+        self._cv = threading.Condition()
+        self._members: Dict[str, _Member] = {}   # member id -> _Member
+        self._generation = 0
+        self._join_seq = 0
+        # (generation, seq) -> {rank: payload}; results cached until the
+        # last member of the round has read them
+        self._rounds: Dict[Tuple[int, int], Dict[int, Any]] = {}
+        self._reads: Dict[Tuple[int, int], int] = {}
+        self._stop = False
+        coord = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    line = self.rfile.readline()
+                    if not line:
+                        return
+                    req = json.loads(line.decode())
+                    resp = coord._dispatch(req)
+                # tpulint: disable=TPL006 -- not swallowed: the error is
+                # serialized onto the wire and raised client-side by
+                # ElasticClient._check
+                except Exception as exc:    # noqa: BLE001
+                    resp = {"ok": False, "error": f"{type(exc).__name__}: "
+                                                  f"{exc}"}
+                try:
+                    self.wfile.write(json.dumps(resp).encode() + b"\n")
+                except OSError:
+                    pass                    # client gave up (deadline)
+
+        class Server(socketserver.ThreadingTCPServer):
+            daemon_threads = True
+            allow_reuse_address = True
+
+        self._server = Server((host, port), Handler)
+        self.host, self.port = self._server.server_address[:2]
+        self._threads: List[threading.Thread] = []
+
+    @property
+    def address(self) -> str:
+        return f"{self.host}:{self.port}"
+
+    def start(self) -> str:
+        t = threading.Thread(target=self._server.serve_forever,
+                             name="lgbm-tpu-elastic-coord", daemon=True)
+        t.start()
+        m = threading.Thread(target=self._monitor,
+                             name="lgbm-tpu-elastic-monitor", daemon=True)
+        m.start()
+        self._threads = [t, m]
+        log_info(f"elastic coordinator listening on {self.address}")
+        return self.address
+
+    def stop(self) -> None:
+        with self._cv:
+            self._stop = True
+            self._cv.notify_all()
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- introspection (tests, the chaos launcher's kill scheduler) ----
+    def membership(self) -> Dict[str, Any]:
+        with self._cv:
+            ranks = self._ranks()
+            return {
+                "generation": self._generation,
+                "world": len(ranks),
+                "members": [
+                    {"member": m.member, "rank": ranks[m.member],
+                     "state": m.state, "detail": dict(m.detail),
+                     "age_s": time.monotonic() - m.last}
+                    for m in sorted(self._members.values(),
+                                    key=lambda x: x.joined_seq)],
+            }
+
+    # -- internals -----------------------------------------------------
+    def _ranks(self) -> Dict[str, int]:
+        """member id -> rank: contiguous 0..W-1 in join order (a shrink
+        re-ranks survivors — every rank map is per-generation and
+        clients re-learn theirs on resync)."""
+        order = sorted(self._members.values(), key=lambda m: m.joined_seq)
+        return {m.member: r for r, m in enumerate(order)}
+
+    def _bump(self, why: str, **attrs) -> None:
+        """Membership changed: new generation, fail the old one's
+        rounds.  Caller holds ``_cv``."""
+        self._generation += 1
+        self._rounds = {k: v for k, v in self._rounds.items()
+                        if k[0] >= self._generation}
+        self._reads = {k: v for k, v in self._reads.items()
+                       if k[0] >= self._generation}
+        counter_add("elastic.generation_bumps")
+        event("elastic", why, generation=self._generation,
+              world=len(self._members), **attrs)
+        self._cv.notify_all()
+
+    def _monitor(self) -> None:
+        from ..utils.faults import fault_flag
+        tick = max(self.heartbeat_timeout_s / 4.0, 0.02)
+        while True:
+            with self._cv:
+                if self._stop:
+                    return
+                now = time.monotonic()
+                dead = [m for m in self._members.values()
+                        if now - m.last > self.heartbeat_timeout_s]
+                if not dead and fault_flag("rendezvous.drop_rank"):
+                    # the injected lost-rank: drop the newest member
+                    live = sorted(self._members.values(),
+                                  key=lambda m: m.joined_seq)
+                    if live:
+                        dead = [live[-1]]
+                for m in dead:
+                    ranks = self._ranks()
+                    lost_rank = ranks.get(m.member, -1)
+                    del self._members[m.member]
+                    counter_add("elastic.evictions")
+                    log_warning(
+                        f"elastic: rank {lost_rank} ({m.member}) lost "
+                        f"(no heartbeat for {now - m.last:.2f}s); "
+                        f"world {len(self._members) + 1} -> "
+                        f"{len(self._members)}")
+                    self._bump("rank_lost", rank=lost_rank,
+                               member=m.member,
+                               last_state=m.state or "unknown")
+                self._cv.wait(tick)
+
+    def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        if op == "join":
+            return self._op_join(req)
+        if op == "sync":
+            return self._op_sync(req)
+        if op == "allgather":
+            return self._op_allgather(req)
+        if op == "heartbeat":
+            return self._op_heartbeat(req)
+        if op == "leave":
+            return self._op_leave(req)
+        if op == "info":
+            return {"ok": True, **self.membership()}
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    def _view(self, member: str) -> Dict[str, Any]:
+        ranks = self._ranks()
+        return {"ok": True, "world": len(ranks),
+                "rank": ranks.get(member, -1),
+                "generation": self._generation}
+
+    def _op_join(self, req) -> Dict[str, Any]:
+        member = req["member"]
+        min_world = int(req.get("min_world", 1))
+        with self._cv:
+            if member not in self._members:
+                self._join_seq += 1
+                self._members[member] = _Member(member, self._join_seq)
+                counter_add("elastic.joins")
+                self._bump("join", member=member)
+                rank = self._ranks()[member]
+                log_info(f"elastic: member {member} joined as rank "
+                         f"{rank} (world {len(self._members)}, "
+                         f"generation {self._generation})")
+            # hold until the world is big enough (initial formation)
+            while len(self._members) < min_world \
+                    and member in self._members and not self._stop:
+                self._cv.wait(0.2)
+            if member not in self._members:
+                return {"ok": False, "error": "evicted"}
+            return self._view(member)
+
+    def _op_sync(self, req) -> Dict[str, Any]:
+        with self._cv:
+            if req["member"] not in self._members:
+                return {"ok": False, "error": "evicted"}
+            return self._view(req["member"])
+
+    def _op_heartbeat(self, req) -> Dict[str, Any]:
+        with self._cv:
+            m = self._members.get(req["member"])
+            if m is None:
+                return {"ok": False, "error": "evicted"}
+            m.last = time.monotonic()
+            m.state = str(req.get("state", ""))
+            m.detail = dict(req.get("detail") or {})
+            return self._view(req["member"])
+
+    def _op_leave(self, req) -> Dict[str, Any]:
+        with self._cv:
+            m = self._members.pop(req["member"], None)
+            if m is not None:
+                counter_add("elastic.leaves")
+                self._bump("member_left", member=req["member"])
+                log_info(f"elastic: member {req['member']} left "
+                         f"(world {len(self._members)}, generation "
+                         f"{self._generation})")
+            return {"ok": True, "generation": self._generation}
+
+    def _op_allgather(self, req) -> Dict[str, Any]:
+        member = req["member"]
+        gen = int(req["generation"])
+        seq = int(req["seq"])
+        key = (gen, seq)
+        with self._cv:
+            if member not in self._members:
+                return {"ok": False, "error": "evicted"}
+            if gen != self._generation:
+                return {"ok": False, "error": "generation_changed",
+                        "generation": self._generation}
+            ranks = self._ranks()
+            world = len(ranks)
+            parts = self._rounds.setdefault(key, {})
+            parts[ranks[member]] = req.get("payload")
+            self._cv.notify_all()
+            while True:
+                if self._stop:
+                    return {"ok": False, "error": "coordinator stopped"}
+                if gen != self._generation:
+                    return {"ok": False, "error": "generation_changed",
+                            "generation": self._generation}
+                if len(self._rounds.get(key, ())) >= world:
+                    break
+                self._cv.wait(0.5)
+            payloads = [self._rounds[key][r] for r in range(world)]
+            # drop the round once every member has read it
+            self._reads[key] = self._reads.get(key, 0) + 1
+            if self._reads[key] >= world:
+                self._rounds.pop(key, None)
+                self._reads.pop(key, None)
+            return {"ok": True, "payloads": payloads}
+
+
+# ---------------------------------------------------------------------------
+# client
+# ---------------------------------------------------------------------------
+class ElasticClient:
+    """One training process's handle on the elastic world.
+
+    ``join`` -> ``(world, rank, generation)``; ``allgather``/``barrier``
+    are the generation-scoped collectives; a daemon heartbeat thread
+    keeps membership alive and carries the live health state (the
+    wedged-vs-dead signal).  All blocking calls are bounded by
+    ``deadline_s`` and raise :class:`RankLostError` on expiry."""
+
+    def __init__(self, address: Optional[str] = None,
+                 member: Optional[str] = None,
+                 deadline_s: Optional[float] = None,
+                 heartbeat_interval_s: Optional[float] = None):
+        addr = address or elastic_address()
+        if not addr:
+            raise ValueError("no elastic coordinator address (pass one "
+                             "or set LGBM_TPU_ELASTIC=host:port)")
+        host, _, port = addr.rpartition(":")
+        self.host, self.port = host or "127.0.0.1", int(port)
+        self.member = member or (os.environ.get("LGBM_TPU_ELASTIC_MEMBER")
+                                 or f"m-{uuid.uuid4().hex[:12]}")
+        self.deadline_s = (deadline_s if deadline_s is not None
+                           else (collective_deadline_s() or 300.0))
+        self.heartbeat_interval_s = (heartbeat_interval_s
+                                     if heartbeat_interval_s is not None
+                                     else heartbeat_s())
+        self.world = 0
+        self.rank = -1
+        self.generation = -1
+        self.seq = 0
+        self._status: Dict[str, Any] = {}
+        self._hb_thread: Optional[threading.Thread] = None
+        self._hb_stop = threading.Event()
+        self._hb_pause = threading.Event()
+
+    # -- transport -----------------------------------------------------
+    def _rpc(self, msg: Dict[str, Any],
+             timeout: Optional[float] = None) -> Dict[str, Any]:
+        timeout = self.deadline_s if timeout is None else timeout
+        site = f"elastic.{msg.get('op')}"
+        try:
+            with socket.create_connection((self.host, self.port),
+                                          timeout=timeout) as sock:
+                sock.settimeout(timeout)
+                f = sock.makefile("rwb")
+                f.write(json.dumps(msg).encode() + b"\n")
+                f.flush()
+                line = f.readline()
+            if not line:
+                raise RankLostError(site, timeout,
+                                    "coordinator closed the connection")
+            return json.loads(line.decode())
+        except socket.timeout:
+            counter_add("collective.deadline_exceeded")
+            event("elastic", "rank_lost", site=site, deadline_s=timeout)
+            raise RankLostError(site, timeout) from None
+
+    def _check(self, resp: Dict[str, Any]) -> Dict[str, Any]:
+        if resp.get("ok"):
+            return resp
+        err = resp.get("error", "")
+        if err == "generation_changed":
+            counter_add("elastic.generation_changed")
+            raise GenerationChanged(resp.get("generation", -1))
+        if err == "evicted":
+            raise EvictedError(f"member {self.member} was evicted "
+                               "(missed heartbeats); re-join required")
+        raise RuntimeError(f"elastic coordinator error: {err}")
+
+    # -- membership ----------------------------------------------------
+    def join_world(self, min_world: int = 1) -> Tuple[int, int, int]:
+        """(Re)join the world; blocks until ``min_world`` members are
+        present.  Returns ``(world, rank, generation)`` and starts the
+        heartbeat.  Retried through the shared policy with the
+        ``rendezvous.connect`` fault point in front (the same seam
+        ``mesh.init_distributed`` exposes)."""
+        from ..utils.faults import fault_point
+        from ..utils.retry import retry_call
+
+        def _join():
+            fault_point("rendezvous.connect")
+            return self._check(self._rpc(
+                {"op": "join", "member": self.member,
+                 "min_world": int(min_world)}))
+
+        with span("elastic.rendezvous", member=self.member,
+                  min_world=int(min_world)):
+            resp = retry_call(_join, what="elastic.join")
+        self._adopt(resp)
+        event("elastic", "joined", rank=self.rank, world=self.world,
+              generation=self.generation)
+        self._start_heartbeat()
+        return self.world, self.rank, self.generation
+
+    def resync(self) -> Tuple[int, int, int]:
+        """Adopt the current membership view (after a
+        :class:`GenerationChanged`); in-flight sequence numbers reset —
+        collectives are scoped per generation."""
+        with span("elastic.rendezvous", member=self.member, resync=1):
+            resp = self._check(self._rpc({"op": "sync",
+                                          "member": self.member}))
+        self._adopt(resp)
+        return self.world, self.rank, self.generation
+
+    def _adopt(self, resp: Dict[str, Any]) -> None:
+        self.world = int(resp["world"])
+        self.rank = int(resp["rank"])
+        if int(resp["generation"]) != self.generation:
+            self.seq = 0
+        self.generation = int(resp["generation"])
+
+    def leave(self) -> None:
+        self._hb_stop.set()
+        try:
+            self._rpc({"op": "leave", "member": self.member}, timeout=5.0)
+        except (RankLostError, OSError):
+            pass
+
+    def close(self) -> None:
+        self._hb_stop.set()
+        if self._hb_thread is not None:
+            self._hb_thread.join(timeout=2.0)
+
+    # -- collectives ---------------------------------------------------
+    def allgather(self, obj: Any) -> List[Any]:
+        """Rank-ordered allgather of a JSON-serializable object within
+        the current generation.  Raises :class:`GenerationChanged` when
+        the membership moved, :class:`RankLostError` past the deadline
+        (the ``collective.hang`` fault stalls this call to prove the
+        deadline detects it)."""
+        from ..utils.faults import fault_flag
+        self.seq += 1
+        if fault_flag("collective.hang"):
+            time.sleep(self.deadline_s * 1.5 + 0.05)
+        resp = self._check(self._rpc(
+            {"op": "allgather", "member": self.member,
+             "generation": self.generation, "seq": self.seq,
+             "payload": obj}))
+        return resp["payloads"]
+
+    def barrier(self, tag: str) -> None:
+        """All current members reach ``tag`` (an allgather of the tag;
+        mismatched tags are a protocol desync and raise loudly)."""
+        tags = self.allgather({"barrier": tag})
+        if any(t != {"barrier": tag} for t in tags):
+            raise RuntimeError(f"elastic barrier desync at {tag!r}: "
+                               f"{tags}")
+
+    # -- heartbeats ----------------------------------------------------
+    def set_status(self, **detail: Any) -> None:
+        """Attach status to this member's heartbeats (the chaos
+        launcher schedules kills off it; operators see it in
+        ``info()``)."""
+        self._status.update(detail)
+
+    def pause_heartbeats(self, pause: bool = True) -> None:
+        """Test hook: a paused heartbeat thread is a dead rank as far
+        as the coordinator can tell."""
+        if pause:
+            self._hb_pause.set()
+        else:
+            self._hb_pause.clear()
+
+    def _start_heartbeat(self) -> None:
+        if self._hb_thread is not None and self._hb_thread.is_alive():
+            return
+        self._hb_stop.clear()
+        self._hb_thread = threading.Thread(
+            target=self._hb_run, name=f"lgbm-tpu-heartbeat-{self.member}",
+            daemon=True)
+        self._hb_thread.start()
+
+    def _hb_run(self) -> None:
+        from ..obs import health
+        from ..utils.faults import fault_flag
+        while not self._hb_stop.wait(self.heartbeat_interval_s):
+            if self._hb_pause.is_set():
+                continue
+            if fault_flag("heartbeat.miss"):
+                continue            # the injected dropped beat
+            try:
+                resp = self._rpc(
+                    {"op": "heartbeat", "member": self.member,
+                     "state": health.state()["state"],
+                     "detail": dict(self._status)},
+                    timeout=max(self.heartbeat_interval_s * 2, 1.0))
+            except (RankLostError, OSError, ValueError):
+                continue            # next beat retries; eviction is the
+                #                     coordinator's judgement, not ours
+            if resp.get("ok"):
+                # learn of membership churn between collectives
+                self.generation = max(self.generation,
+                                      int(resp.get("generation", -1)))
+
+
+class ElasticRun:
+    """One generation's frozen view, handed to the streamed trainer:
+    the client plus the (world, rank, generation) it will train under
+    and the run-lifetime protocol shard count ``num_shards`` — FIXED
+    across membership changes, so per-shard partials combine in shard
+    order and any world size reproduces the same bytes."""
+
+    def __init__(self, client: ElasticClient, num_shards: int):
+        self.client = client
+        self.world = client.world
+        self.rank = client.rank
+        self.generation = client.generation
+        self.num_shards = int(num_shards)
+        if self.num_shards < 1:
+            raise ValueError("num_shards must be >= 1")
+
+    def owned_shards(self) -> Tuple[int, ...]:
+        """The mod-world shard slice (the out-of-core store's
+        ``sources[r::S]`` rule, applied to protocol shards)."""
+        return tuple(s for s in range(self.num_shards)
+                     if s % self.world == self.rank)
+
+    def allgather(self, obj: Any) -> List[Any]:
+        if self.client.generation != self.generation:
+            raise GenerationChanged(self.client.generation,
+                                    "membership moved under this run")
+        return self.client.allgather(obj)
+
+    def barrier(self, tag: str) -> None:
+        if self.client.generation != self.generation:
+            raise GenerationChanged(self.client.generation,
+                                    "membership moved under this run")
+        self.client.barrier(tag)

@@ -54,7 +54,22 @@ the seams where production faults actually strike:
   torn blob stays under its tmp name, the shard's sidecar is never
   published, and a re-run re-ingests exactly the unfinished shards —
   the manifest is written last, so a killed ingest can never be
-  mistaken for a complete one.
+  mistaken for a complete one,
+* ``collective.hang`` — a SILENT fault (``fault_flag``): the host
+  collective SLEEPS past ``LGBM_TPU_COLLECTIVE_DEADLINE_S`` instead of
+  raising (``io/distributed.deadline_call``, elastic client
+  allgathers) — exercising rank-loss *detection* (the deadline path
+  must raise a typed ``RankLostError``), where ``collective.allgather``
+  exercises retry,
+* ``rendezvous.drop_rank`` — a SILENT fault: the elastic coordinator's
+  monitor (``parallel/elastic.py``) evicts its newest member as if its
+  heartbeats stopped — a lost rank without killing a process, so
+  in-process tests drive generation bumps and survivor recovery,
+* ``heartbeat.miss`` — a SILENT fault: the elastic client's heartbeat
+  thread skips beats while armed; enough armed shots and the
+  coordinator evicts the member (the dead-rank signal), few and the
+  member survives (heartbeats are retried, not load-bearing
+  one-shots).
 
 Each point is a single ``fault_point(name)`` call that is a no-op unless
 armed.  Tests arm points programmatically (:func:`inject`, or the
@@ -81,7 +96,8 @@ from typing import Dict, Optional
 POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
           "loader.read", "spmd.skip_record", "serve.score", "mem.leak",
           "det.rng_drift", "watchdog.stall", "health.nan_grad",
-          "ingest.shard_fetch", "ingest.cache_write")
+          "ingest.shard_fetch", "ingest.cache_write", "collective.hang",
+          "rendezvous.drop_rank", "heartbeat.miss")
 
 
 class FaultInjected(RuntimeError):

@@ -96,12 +96,13 @@ def test_deadline_skips_aux_legs_with_markers(bench_run):
     assert final["value"] > 0               # headline retained
     for leg in ("serve", "serve_load", "valid", "bin255", "rank", "rank63",
                 "multichip", "split_finder", "rank_grad", "attribution",
-                "stream"):
+                "stream", "elastic"):
         assert final.get(f"{leg}_leg") == "skipped: budget", final
     assert final.get("real_data") == "skipped: budget"
     assert set(final.get("legs_skipped", [])) >= {
         "serve", "serve_load", "valid", "bin255", "rank", "rank63",
-        "multichip", "split_finder", "rank_grad", "attribution", "stream"}
+        "multichip", "split_finder", "rank_grad", "attribution", "stream",
+        "elastic"}
     # an explicit skip is not a failure: no legs_failed / hard-failed
     assert "legs_failed" not in final
     assert "legs_hard_failed" not in final
@@ -242,6 +243,25 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
         and len(out["stream_model_digest"]) == 64
     assert out["north_star_aux_detail"]["stream_ingest"] in (
         "measured", "pending-capture"), out["north_star_aux_detail"]
+    # elastic chaos gate (ISSUE 16): the REAL SIGKILL shrink+regrow
+    # scenario ran in a CPU subprocess — one worker killed mid-window,
+    # the survivor re-rendezvoused and resumed from the last committed
+    # barrier, a replacement joiner regrew the world, and BOTH results
+    # are byte-identical to the uninterrupted 1-process oracle
+    assert out["elastic_ok"] is True, out.get(
+        "elastic_leg", out.get("elastic_errors"))
+    from bench import ELASTIC_SCHEMA_KEYS
+    for key in ELASTIC_SCHEMA_KEYS:
+        assert key in out, key
+    assert out["elastic_identity_ok"] is True
+    assert out["elastic_recovery_ok"] is True
+    assert out["elastic_workers"] >= 2
+    assert out["elastic_respawned"]
+    assert out["elastic_wall_s"] > 0
+    assert isinstance(out["elastic_oracle_sha256"], str) \
+        and len(out["elastic_oracle_sha256"]) == 64
+    assert out["north_star_aux_detail"]["elastic"] in (
+        "measured", "pending-capture"), out["north_star_aux_detail"]
     # device-time attribution gate (ISSUE 10): the REAL leg ran at toy
     # shape — windowed LGBM_TPU_PROFILE capture, parsed, >= 90% of the
     # captured device time attributed to named spans, host-gap and
@@ -319,6 +339,7 @@ def test_gate_bearing_hard_failure_zeroes_headline():
            "BENCH_WAVES": "0", "BENCH_SERVE": "0",
            "BENCH_SERVE_LOAD": "0",
            "BENCH_ATTRIBUTION": "0",   # this test gates the valid leg
+           "BENCH_ELASTIC": "0",       # chaos scenario covered elsewhere
            "BENCH_FORCE_FAIL": "valid"}
     env.pop("XLA_FLAGS", None)
     env.pop("BENCH_DATA", None)

@@ -1,0 +1,519 @@
+"""Elastic training suite (parallel/elastic.py + the barrier snapshot
+discipline + tools/chaos.py).
+
+ISSUE 16 acceptance, all on CPU in tier-1:
+
+* generation'd rendezvous — every (re)join returns ``(world, rank,
+  generation)``; ANY membership change bumps the generation and fails
+  in-flight collectives with ``GenerationChanged``,
+* rank-failure detection — a hung collective (injected
+  ``collective.hang``) raises a typed ``RankLostError`` WITHIN the
+  configured deadline; peer heartbeats distinguish wedged-but-alive
+  (stalled state, still beating — NOT evicted) from dead (beats stop —
+  evicted),
+* coordinated recovery — barrier snapshots commit only when every rank
+  publishes the same ``(iteration, model digest)``; a SIGKILL between
+  the shard publish and the manifest leaves a torn barrier that
+  validation skips; survivors resume from the last committed barrier
+  and the final model is BYTE-IDENTICAL to the uninterrupted run
+  (``tools/chaos.py`` drives the real-SIGKILL shrink + regrow gate).
+"""
+import contextlib
+import json
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu import obs
+from lightgbm_tpu.boosting import snapshot as snap
+from lightgbm_tpu.boosting.streaming import (StreamTrainer, elastic_shards,
+                                             train_elastic)
+from lightgbm_tpu.config import Config
+from lightgbm_tpu.io.dataset import BinnedDataset, Metadata
+from lightgbm_tpu.io.distributed import RankLostError, deadline_call
+from lightgbm_tpu.obs import health
+from lightgbm_tpu.parallel.elastic import (ElasticClient, ElasticCoordinator,
+                                           EvictedError, GenerationChanged,
+                                           decode_array, encode_array)
+from lightgbm_tpu.utils import faults
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.reset()
+    obs.enable()        # the suite asserts elastic:* events + counters
+    faults.clear()
+    yield
+    faults.clear()
+    health._set_active(False)
+    health.reset()
+    obs.disable()
+    obs.reset()
+
+
+@contextlib.contextmanager
+def _coord(heartbeat_timeout_s=5.0):
+    coord = ElasticCoordinator(heartbeat_timeout_s=heartbeat_timeout_s)
+    coord.start()
+    try:
+        yield coord
+    finally:
+        coord.stop()
+
+
+def _client(coord, member, deadline_s=5.0, hb=0.05):
+    return ElasticClient(coord.address, member=member, deadline_s=deadline_s,
+                         heartbeat_interval_s=hb)
+
+
+def _in_thread(fn, *args):
+    box = {}
+
+    def run():
+        try:
+            box["value"] = fn(*args)
+        except BaseException as exc:  # noqa: BLE001 - re-raised by caller
+            box["error"] = exc
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    return t, box
+
+
+def _toy_data(n=240, f=5, seed=9):
+    rng = np.random.default_rng(seed)
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + np.sin(X[:, 2])
+         + rng.normal(scale=0.1, size=n)).astype(np.float32)
+    return X, y
+
+
+def _toy_params(prefix, iters=4, **kw):
+    p = {"objective": "regression", "num_leaves": 7, "min_data_in_leaf": 5,
+         "learning_rate": 0.2, "num_iterations": iters, "seed": 3,
+         "snapshot_freq": 1, "snapshot_keep": 8, "verbose": -1,
+         "output_model": str(prefix)}
+    p.update(kw)
+    return p
+
+
+def _binned(X, y, params):
+    md = Metadata()
+    md.set_field("label", np.asarray(y, np.float32))
+    return BinnedDataset.from_raw(X, Config.from_params(dict(params)),
+                                  metadata=md)
+
+
+# ---------------------------------------------------------------------------
+# protocol: rendezvous, generations, collectives (jax-free)
+# ---------------------------------------------------------------------------
+def test_encode_decode_array_bitwise_roundtrip():
+    for arr in (np.arange(12, dtype=np.float32).reshape(3, 4) / 7,
+                np.array([np.nan, -0.0, np.inf], np.float64),
+                np.arange(5, dtype=np.int64)):
+        back = decode_array(encode_array(arr))
+        assert back.dtype == arr.dtype and back.shape == arr.shape
+        assert np.array_equal(arr.view(np.uint8), back.view(np.uint8))
+
+
+def test_rendezvous_generations_and_rank_order():
+    """Every (re)join returns (world, rank, generation); joins bump the
+    generation; ranks are contiguous in join order."""
+    with _coord() as coord:
+        a = _client(coord, "a")
+        b = _client(coord, "b")
+        try:
+            w, r, g = a.join_world()
+            assert (w, r) == (1, 0) and g >= 1
+            w2, r2, g2 = b.join_world()
+            assert (w2, r2) == (2, 1) and g2 == g + 1
+            # a learns of the churn on resync (same member, new view)
+            assert a.resync() == (2, 0, g2)
+            info = coord.membership()
+            assert info["world"] == 2 and info["generation"] == g2
+            assert [m["member"] for m in info["members"]] == ["a", "b"]
+            assert [m["rank"] for m in info["members"]] == [0, 1]
+        finally:
+            a.close()
+            b.close()
+    s = obs.summary()
+    assert s["events"].get("elastic:joined", 0) >= 2
+
+
+def test_allgather_rank_ordered_and_barrier():
+    with _coord() as coord:
+        a = _client(coord, "a")
+        b = _client(coord, "b")
+        try:
+            ta, boxa = _in_thread(a.join_world, 2)
+            tb, boxb = _in_thread(b.join_world, 2)
+            ta.join(10)
+            tb.join(10)
+            assert boxa["value"][:2] == (2, 0) and boxb["value"][:2] == (2, 1)
+            ta, boxa = _in_thread(a.allgather, {"from": "a"})
+            tb, boxb = _in_thread(b.allgather, {"from": "b"})
+            ta.join(10)
+            tb.join(10)
+            # rank-ordered on BOTH ranks: the partition-invariant fold
+            want = [{"from": "a"}, {"from": "b"}]
+            assert boxa["value"] == want and boxb["value"] == want
+            ta, _ = _in_thread(a.barrier, "sync-point")
+            tb, boxb = _in_thread(b.barrier, "sync-point")
+            ta.join(10)
+            tb.join(10)
+            assert "error" not in boxb
+        finally:
+            a.close()
+            b.close()
+
+
+def test_generation_change_fails_inflight_collective():
+    """The headline rendezvous contract: a membership change invalidates
+    an IN-FLIGHT collective of the old generation (survivors unwind to
+    re-rendezvous instead of deadlocking on a gone member)."""
+    with _coord() as coord:
+        a = _client(coord, "a")
+        b = _client(coord, "b")
+        try:
+            ta, _ = _in_thread(a.join_world, 2)
+            tb, _ = _in_thread(b.join_world, 2)
+            ta.join(10)
+            tb.join(10)
+            gen2 = a.generation
+            t, box = _in_thread(a.allgather, "x")  # blocks waiting for b
+            time.sleep(0.2)
+            b.leave()
+            t.join(10)
+            assert isinstance(box.get("error"), GenerationChanged)
+            assert box["error"].generation > gen2
+            # survivor re-rendezvous: sole member of the new generation
+            w, r, g = a.resync()
+            assert (w, r) == (1, 0) and g > gen2
+        finally:
+            a.close()
+            b.close()
+
+
+def test_hung_collective_raises_ranklost_within_deadline():
+    """ISSUE acceptance: with one rank's collective hung (injected
+    ``collective.hang``), the healthy peer's allgather raises a typed
+    RankLostError within LGBM_TPU_COLLECTIVE_DEADLINE_S."""
+    deadline = 0.6
+    with _coord() as coord:
+        a = _client(coord, "a", deadline_s=deadline)
+        b = _client(coord, "b", deadline_s=deadline)
+        try:
+            ta, _ = _in_thread(a.join_world, 2)
+            tb, _ = _in_thread(b.join_world, 2)
+            ta.join(10)
+            tb.join(10)
+            faults.inject("collective.hang", times=1)
+            th, _ = _in_thread(a.allgather, "hung")  # consumes the fault
+            time.sleep(0.05)
+            assert faults.fired("collective.hang") == 1
+            t0 = time.monotonic()
+            with pytest.raises(RankLostError) as err:
+                b.allgather("healthy")
+            elapsed = time.monotonic() - t0
+            assert elapsed < deadline + 1.0, \
+                f"detection took {elapsed:.2f}s for a {deadline}s deadline"
+            assert err.value.deadline_s == deadline
+            th.join(5)
+        finally:
+            a.close()
+            b.close()
+    s = obs.summary()
+    assert s["events"].get("elastic:rank_lost", 0) >= 1
+    assert s["counters"].get("collective.deadline_exceeded", 0) >= 1
+
+
+def test_deadline_call_detects_hang():
+    """io/distributed.deadline_call unit: value passthrough, error
+    passthrough, and the injected hang raising within the deadline."""
+    assert deadline_call(lambda: 41 + 1, "t", deadline=0.5) == 42
+    assert deadline_call(lambda: "inline", "t", deadline=None) == "inline"
+    with pytest.raises(ZeroDivisionError):
+        deadline_call(lambda: 1 // 0, "t", deadline=0.5)
+    faults.inject("collective.hang", times=1)
+    t0 = time.monotonic()
+    with pytest.raises(RankLostError):
+        deadline_call(lambda: "late", "t", deadline=0.2)
+    assert time.monotonic() - t0 < 1.0
+    assert faults.fired("collective.hang") == 1
+
+
+def test_heartbeat_wedged_vs_dead():
+    """Wedged-but-alive (watchdog says stalled, heartbeats keep coming)
+    is NOT evicted — the state is surfaced for the operator instead.
+    Dead (beats stop — injected ``heartbeat.miss``) IS evicted, bumping
+    the generation; the evictee's next collective says so."""
+    with _coord(heartbeat_timeout_s=0.4) as coord:
+        a = _client(coord, "wedged", hb=0.05)
+        try:
+            _, _, gen = a.join_world()
+            health._set_active(True)
+            health.mark_stalled("train_window")
+            time.sleep(1.0)  # 2.5x the eviction timeout, still beating
+            info = coord.membership()
+            assert info["world"] == 1 and info["generation"] == gen
+            assert info["members"][0]["state"] == "stalled"
+            # now the beats stop: dead as far as the coordinator knows
+            faults.inject("heartbeat.miss", times=1000)
+            deadline = time.monotonic() + 5.0
+            while coord.membership()["world"] and time.monotonic() < deadline:
+                time.sleep(0.05)
+            info = coord.membership()
+            assert info["world"] == 0 and info["generation"] > gen
+            assert faults.fired("heartbeat.miss") >= 1
+            with pytest.raises(EvictedError):
+                a.allgather("x")
+        finally:
+            a.close()
+    s = obs.summary()
+    assert s["events"].get("elastic:rank_lost", 0) >= 1
+    assert s["counters"].get("elastic.evictions", 0) >= 1
+
+
+def test_drop_rank_fault_evicts_newest_member():
+    """The ``rendezvous.drop_rank`` fault point: a lost rank without
+    killing a process — the monitor evicts the newest member and the
+    survivor re-ranks in a new generation."""
+    with _coord(heartbeat_timeout_s=0.8) as coord:
+        a = _client(coord, "old", hb=0.05)
+        b = _client(coord, "new", hb=0.05)
+        try:
+            a.join_world()
+            _, _, gen = b.join_world()
+            faults.inject("rendezvous.drop_rank", times=1)
+            deadline = time.monotonic() + 5.0
+            while coord.membership()["world"] != 1 and time.monotonic() < deadline:
+                time.sleep(0.05)
+            info = coord.membership()
+            assert [m["member"] for m in info["members"]] == ["old"]
+            assert faults.fired("rendezvous.drop_rank") == 1
+            assert a.resync() == (1, 0, info["generation"])
+            assert info["generation"] > gen
+            with pytest.raises(EvictedError):
+                b.allgather("x")
+        finally:
+            a.close()
+            b.close()
+
+
+# ---------------------------------------------------------------------------
+# barrier snapshots: commit marker, torn-barrier fallback
+# ---------------------------------------------------------------------------
+def test_barrier_commit_marker_and_torn_fallback(tmp_path):
+    """The manifest is the commit marker: shards-without-manifest (a
+    SIGKILL between the shard publish and the commit) and torn model
+    text are both skipped; recovery lands on the previous COMMITTED
+    barrier, and a barrier from a different shard protocol is never
+    silently resumed."""
+    prefix = str(tmp_path / "m.txt")
+    meta = {"num_shards": 2, "world_size": 2, "generation": 1}
+    for it in (2, 4):
+        shas = {s: snap.write_barrier_shard(
+            prefix, it, s, np.full((3, 1), it + s, np.float32))
+            for s in range(2)}
+        snap.commit_barrier(prefix, it, f"model-at-{it}\n", shas, meta,
+                            keep=8)
+    assert [it for it, _ in snap.list_barriers(prefix)] == [4, 2]
+    # SIGKILL between shard publish and manifest: no commit marker ever
+    # appears for iteration 6, so it is invisible to recovery
+    snap.write_barrier_shard(prefix, 6, 0, np.zeros((3, 1), np.float32))
+    snap.write_barrier_shard(prefix, 6, 1, np.zeros((3, 1), np.float32))
+    man = snap.latest_valid_barrier(prefix)
+    assert man is not None and man["iteration"] == 4
+    assert sorted(man["shard_paths"]) == [0, 1]
+    # different shard protocol = different identity domain: no resume
+    assert snap.latest_valid_barrier(prefix, num_shards=3) is None
+    # torn model text at 4: all-or-nothing validation falls back to 2
+    with open(snap.barrier_paths(prefix, 4)[0], "a") as f:
+        f.write("x")
+    man = snap.latest_valid_barrier(prefix, num_shards=2)
+    assert man is not None and man["iteration"] == 2
+    # a corrupt shard state tears the whole barrier too
+    with open(snap.barrier_shard_path(prefix, 2, 1), "ab") as f:
+        f.write(b"x")
+    assert snap.latest_valid_barrier(prefix) is None
+
+
+def test_snapshot_resume_rejects_world_size_mismatch(tmp_path):
+    """Classic (non-barrier) snapshots record the mesh size they were
+    written on; resuming on a different world is a refusal, not a
+    silent wrong-layout run (re-shard via elastic instead)."""
+    X, y = _toy_data()
+    prefix = tmp_path / "w.txt"
+    params = {"objective": "regression", "num_leaves": 7,
+              "min_data_in_leaf": 5, "learning_rate": 0.2, "verbose": -1,
+              "snapshot_freq": 2, "output_model": str(prefix)}
+    lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=4,
+              verbose_eval=False)
+    it, manifest_path = snap.list_snapshots(str(prefix))[0]
+    with open(manifest_path) as f:
+        manifest = json.load(f)
+    assert manifest["world_size"] == 1
+    manifest["world_size"] = 3
+    with open(manifest_path, "w") as f:
+        json.dump(manifest, f)
+    with pytest.raises(ValueError, match="3-process mesh"):
+        lgb.train(params, lgb.Dataset(X, label=y), num_boost_round=6,
+                  verbose_eval=False, resume_from=manifest_path)
+
+
+# ---------------------------------------------------------------------------
+# elastic training: identity domain, barrier restore, recovery
+# ---------------------------------------------------------------------------
+def test_elastic_world1_matches_oracle_and_restores(tmp_path):
+    """The identity domain is (data, config, S): a 1-member elastic run
+    at S=2 lands on the plain single-process trainer's bytes; a torn
+    newest barrier falls back to the previous committed one and the
+    resumed run reproduces the oracle byte-for-byte; shard-protocol and
+    config changes refuse to resume."""
+    prefix = tmp_path / "m.txt"
+    params = _toy_params(prefix, iters=4, snapshot_freq=2)
+    X, y = _toy_data()
+    ds = _binned(X, y, params)
+    with _coord() as coord:
+        c = _client(coord, "solo", deadline_s=10.0)
+        try:
+            booster = train_elastic(params, ds, num_shards=2, client=c)
+        finally:
+            c.leave()
+            c.close()
+    oracle_cfg = Config.from_params(dict(params, snapshot_freq=-1))
+    oracle = StreamTrainer(oracle_cfg, ds, num_shards=2).train()
+    text = oracle.save_model_to_string(-1)
+    assert booster.save_model_to_string(-1) == text
+    assert booster.digest() == oracle.digest()
+    assert [it for it, _ in snap.list_barriers(str(prefix))] == [4, 2]
+    # tear the newest barrier (the mid-commit SIGKILL shape): restore
+    # lands on iteration 2 and the continued run matches the oracle
+    os.unlink(snap.barrier_paths(str(prefix), 4)[1])
+    resumed = StreamTrainer(oracle_cfg, ds, num_shards=2)
+    assert resumed.restore_barrier(str(prefix)) == 2
+    final = resumed.train()
+    assert final.save_model_to_string(-1) == text
+    # a different protocol shard count never adopts these barriers
+    other = StreamTrainer(oracle_cfg, ds, num_shards=3)
+    assert other.restore_barrier(str(prefix)) == 0
+    # a changed config is a different model: refuse, don't blend
+    changed = Config.from_params(dict(params, learning_rate=0.05))
+    with pytest.raises(ValueError, match="config changed"):
+        StreamTrainer(changed, ds, num_shards=2).restore_barrier(str(prefix))
+
+
+def test_membership_churn_recovery_byte_identical(tmp_path):
+    """A member joining and leaving mid-train bumps the generation; the
+    trainer's in-flight collectives fail, it re-rendezvouses, restores
+    the last committed barrier, and still produces the oracle's bytes —
+    with /healthz back to ready and elastic:recover on the wire."""
+    prefix = tmp_path / "m.txt"
+    params = _toy_params(prefix, iters=8, snapshot_freq=1)
+    X, y = _toy_data(n=300)
+    ds = _binned(X, y, params)
+    health._set_active(True)
+    with _coord() as coord:
+        trainer = _client(coord, "trainer", deadline_s=1.5)
+        t, box = _in_thread(
+            lambda: train_elastic(params, ds, num_shards=2, client=trainer))
+        try:
+            # wait for training to be underway (heartbeats carry the
+            # iteration), then disturb the membership
+            deadline = time.monotonic() + 60.0
+            while time.monotonic() < deadline:
+                members = coord.membership()["members"]
+                if any(m["detail"].get("iteration", 0) >= 1
+                       for m in members):
+                    break
+                time.sleep(0.02)
+            intruder = _client(coord, "intruder")
+            intruder.join_world()
+            intruder.leave()
+            intruder.close()
+            t.join(120)
+            assert not t.is_alive()
+        finally:
+            trainer.leave()
+            trainer.close()
+    assert "error" not in box, box.get("error")
+    oracle = StreamTrainer(Config.from_params(dict(params, snapshot_freq=-1)),
+                           ds, num_shards=2).train()
+    assert box["value"].save_model_to_string(-1) == \
+        oracle.save_model_to_string(-1)
+    assert box["value"].digest() == oracle.digest()
+    s = obs.summary()
+    assert s["events"].get("elastic:recover", 0) >= 1
+    assert s["counters"].get("elastic.recoveries", 0) >= 1
+    assert health.state()["state"] == "ready"
+
+
+def test_health_walks_ready_recovering_ready():
+    """mark_recovering is non-sticky: a completed recovery returns
+    /healthz to ready (unlike stalled/degraded, which are incidents)."""
+    health._set_active(True)
+    health.reset()
+    health.mark_ready()
+    assert health.state()["state"] == "ready"
+    health.mark_recovering(reason="RankLostError")
+    st = health.state()
+    assert st["state"] == "recovering"
+    assert st["detail"]["reason"] == "RankLostError"
+    health.mark_ready()
+    assert health.state()["state"] == "ready"
+
+
+def test_elastic_shards_resolution(monkeypatch):
+    assert elastic_shards(4) == 4
+    assert elastic_shards(4, explicit=6) == 6
+    monkeypatch.setenv("LGBM_TPU_ELASTIC_SHARDS", "3")
+    assert elastic_shards(4) == 3
+    assert elastic_shards(0) == 3
+    monkeypatch.delenv("LGBM_TPU_ELASTIC_SHARDS")
+    assert elastic_shards(0) == 1
+
+
+# ---------------------------------------------------------------------------
+# the chaos gate: real SIGKILL, real processes, byte-identity back
+# ---------------------------------------------------------------------------
+def test_chaos_sigkill_shrink_and_regrow_byte_identical(tmp_path):
+    """ISSUE acceptance, end-to-end: SIGKILL a worker mid-window, let
+    the survivor shrink to world 1, regrow with a replacement joiner,
+    and demand every survivor's final model text sha AND score digest
+    equal the uninterrupted single-process oracle's."""
+    from tools.chaos import run_chaos
+    verdict = run_chaos(workers=2, shards=2, iters=4, rows=256, features=6,
+                        leaves=7, snapshot_freq=1, kill_iter=2,
+                        respawn=True, rundir=str(tmp_path), timeout_s=300.0)
+    assert verdict["errors"] == [], verdict
+    assert verdict["ok"]
+    assert verdict["killed"]["member"] == "worker-1"
+    assert verdict["respawned"] == "joiner-0"
+    members = {r["member"] for r in verdict["results"]}
+    assert members == {"worker-0", "joiner-0"}
+    shas = {r["model_sha256"] for r in verdict["results"]}
+    assert shas == {verdict["oracle"]["model_sha256"]}
+    digests = {r["digest"] for r in verdict["results"]}
+    assert digests == {verdict["oracle"]["digest"]}
+
+
+@pytest.mark.slow
+def test_chaos_uninterrupted_control_two_process(tmp_path):
+    """Control leg: a clean 2-process elastic run (no kill) also lands
+    on the 1-process oracle's bytes — world size is not part of the
+    identity domain."""
+    from tools.chaos import run_chaos
+    verdict = run_chaos(workers=2, shards=2, iters=6, rows=400, features=6,
+                        leaves=7, snapshot_freq=2, kill_iter=None,
+                        rundir=str(tmp_path), timeout_s=300.0)
+    assert verdict["errors"] == [], verdict
+    assert {r["member"] for r in verdict["results"]} == \
+        {"worker-0", "worker-1"}
+    assert {r["model_sha256"] for r in verdict["results"]} == \
+        {verdict["oracle"]["model_sha256"]}

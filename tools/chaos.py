@@ -1,0 +1,318 @@
+"""Chaos harness — SIGKILL a rank mid-train, demand byte-identity back.
+
+The elastic protocol's headline guarantee (ISSUE: "byte-identical
+recovery") is only credible against a REAL dead process: a thread-level
+fake cannot die between a barrier commit's shard publish and its
+manifest, and cannot leave a half-written socket.  This launcher:
+
+1. hosts an :class:`~lightgbm_tpu.parallel.elastic.ElasticCoordinator`
+   in-process,
+2. spawns N worker processes (``python -m tools.chaos --worker spec``)
+   that build the SAME synthetic dataset from the spec's seed and train
+   it through :func:`~lightgbm_tpu.boosting.streaming.train_elastic`,
+3. watches worker progress through the coordinator's heartbeat detail
+   (``membership()``) and delivers ``SIGKILL`` — not SIGTERM; no atexit, no
+   flushes — to the victim the moment it reports the kill iteration,
+4. optionally respawns a replacement joiner (regrow coverage),
+5. trains the uninterrupted single-process oracle in-parent with the
+   same protocol shard count ``S``, and
+6. exits nonzero unless EVERY surviving worker's final model text
+   sha256 AND score digest equal the oracle's.
+
+Because the identity domain is ``(data, config, S)`` — never the world
+size or membership history (``boosting/streaming.py`` module docstring)
+— the single-process oracle doubles as the any-world oracle: a clean
+2-process run, a killed-and-shrunk run, and a killed-and-regrown run
+must all land on the oracle's bytes.
+
+Usage (the tier-1 gate runs the toy shape; bench's ``elastic`` leg
+re-uses :func:`run_chaos` programmatically)::
+
+    python -m tools.chaos --workers 2 --kill-iter 3            # shrink
+    python -m tools.chaos --workers 2 --kill-iter 3 --respawn  # regrow
+    python -m tools.chaos --workers 2 --no-kill                # control
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+from typing import Any, Dict, List, Optional
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# shared spec -> (params, dataset): the parent's oracle and every worker
+# must construct bitwise-identical inputs from the spec alone
+# ---------------------------------------------------------------------------
+def default_spec(rundir: str, workers: int = 2, shards: int = 0,
+                 iters: int = 8, rows: int = 600, features: int = 8,
+                 leaves: int = 7, snapshot_freq: int = 1,
+                 seed: int = 7) -> Dict[str, Any]:
+    return {
+        "rows": int(rows), "features": int(features), "seed": int(seed),
+        "shards": int(shards) or int(workers),
+        "params": {
+            "objective": "regression", "num_leaves": int(leaves),
+            "num_iterations": int(iters), "learning_rate": 0.2,
+            "min_data_in_leaf": 5, "feature_fraction": 0.8, "seed": 3,
+            "snapshot_freq": int(snapshot_freq), "snapshot_keep": 2,
+            "output_model": os.path.join(rundir, "chaos_model.txt"),
+            "verbose": -1,
+        },
+    }
+
+
+def build_inputs(spec: Dict[str, Any]):
+    """spec -> (params, BinnedDataset).  Pure function of the spec."""
+    import numpy as np
+
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.io.dataset import BinnedDataset, Metadata
+
+    rng = np.random.default_rng(spec["seed"])
+    n, f = spec["rows"], spec["features"]
+    X = rng.normal(size=(n, f))
+    y = (X[:, 0] + 0.5 * X[:, 1] ** 2 + np.sin(X[:, 2])
+         + rng.normal(scale=0.1, size=n))
+    params = dict(spec["params"])
+    md = Metadata()
+    md.set_field("label", y.astype(np.float32))
+    ds = BinnedDataset.from_raw(X, Config.from_params(dict(params)),
+                                metadata=md)
+    return params, ds
+
+
+def _model_identity(booster) -> Dict[str, str]:
+    import hashlib
+    text = booster.save_model_to_string(-1)
+    return {"model_sha256": hashlib.sha256(text.encode()).hexdigest(),
+            "digest": booster.digest()}
+
+
+# ---------------------------------------------------------------------------
+# worker mode
+# ---------------------------------------------------------------------------
+def worker_main(spec_path: str) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    with open(spec_path) as f:
+        spec = json.load(f)
+
+    from lightgbm_tpu.boosting.streaming import StreamTrainer, train_elastic
+
+    # iteration floor: at toy shape a warm-cache worker can burn through
+    # every iteration between two heartbeats, closing the kill window
+    # before the launcher ever sees the victim's progress.  The throttle
+    # (a sleep, identity-neutral) guarantees each reported iteration is
+    # observable, so the SIGKILL lands at the REQUESTED iteration.
+    slow = float(os.environ.get("LGBM_TPU_CHAOS_ITER_SLEEP_S", "0") or 0)
+    if slow > 0:
+        orig_iter = StreamTrainer._train_one_iter
+
+        def throttled(self, it):
+            time.sleep(slow)
+            return orig_iter(self, it)
+
+        StreamTrainer._train_one_iter = throttled
+
+    params, ds = build_inputs(spec)
+    booster = train_elastic(params, ds, num_shards=spec["shards"],
+                            min_world=int(spec.get("min_world", 1)))
+    member = os.environ.get("LGBM_TPU_ELASTIC_MEMBER", f"pid{os.getpid()}")
+    result = dict(_model_identity(booster), member=member)
+    out = os.path.join(os.path.dirname(spec_path), f"result-{member}.json")
+    with open(out + ".tmp", "w") as f:
+        json.dump(result, f)
+    os.replace(out + ".tmp", out)
+    print(f"[chaos-worker {member}] OK {result['model_sha256'][:12]}")
+    return 0
+
+
+# ---------------------------------------------------------------------------
+# launcher
+# ---------------------------------------------------------------------------
+def _spawn(rundir: str, spec_path: str, address: str,
+           member: str) -> subprocess.Popen:
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "LGBM_TPU_ELASTIC": address,
+        "LGBM_TPU_ELASTIC_MEMBER": member,
+        "LGBM_TPU_HEARTBEAT_S": env.get("LGBM_TPU_HEARTBEAT_S", "0.1"),
+        "LGBM_TPU_CHAOS_ITER_SLEEP_S":
+            env.get("LGBM_TPU_CHAOS_ITER_SLEEP_S", "0.25"),
+        "LGBM_TPU_COLLECTIVE_DEADLINE_S":
+            env.get("LGBM_TPU_COLLECTIVE_DEADLINE_S", "60"),
+        "PYTHONPATH": _REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    log = open(os.path.join(rundir, f"log-{member}.txt"), "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "tools.chaos", "--worker", spec_path],
+        cwd=_REPO, env=env, stdout=log, stderr=subprocess.STDOUT)
+
+
+def run_chaos(workers: int = 2, shards: int = 0, iters: int = 8,
+              rows: int = 600, features: int = 8, leaves: int = 7,
+              snapshot_freq: int = 1, kill_iter: Optional[int] = 3,
+              kill_member: int = 1, respawn: bool = False,
+              rundir: Optional[str] = None,
+              timeout_s: float = 420.0) -> Dict[str, Any]:
+    """One chaos scenario end-to-end; returns the verdict dict (key
+    ``ok``).  ``kill_iter=None`` is the uninterrupted control run."""
+    from lightgbm_tpu.boosting.streaming import StreamTrainer
+    from lightgbm_tpu.config import Config
+    from lightgbm_tpu.parallel.elastic import ElasticCoordinator
+
+    rundir = rundir or tempfile.mkdtemp(prefix="lgbm_tpu_chaos_")
+    spec = default_spec(rundir, workers=workers, shards=shards,
+                        iters=iters, rows=rows, features=features,
+                        leaves=leaves, snapshot_freq=snapshot_freq)
+    spec["min_world"] = workers
+    spec_path = os.path.join(rundir, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f, indent=1)
+
+    # the single-process oracle at the same protocol shard count: the
+    # identity every run — any world, any kill — must reproduce
+    params, ds = build_inputs(spec)
+    oracle_params = dict(params, snapshot_freq=-1)
+    oracle = StreamTrainer(Config.from_params(oracle_params), ds,
+                           num_shards=spec["shards"]).train()
+    want = _model_identity(oracle)
+
+    coord = ElasticCoordinator(heartbeat_timeout_s=1.0)
+    address = coord.start()
+    procs: Dict[str, subprocess.Popen] = {}
+    verdict: Dict[str, Any] = {
+        "ok": False, "rundir": rundir, "oracle": want, "killed": None,
+        "respawned": None, "results": [], "errors": [],
+    }
+    try:
+        for i in range(workers):
+            member = f"worker-{i}"
+            procs[member] = _spawn(rundir, spec_path, address, member)
+
+        victim = f"worker-{kill_member}" if kill_iter is not None else None
+        deadline = time.monotonic() + timeout_s
+        respawned = 0
+        while time.monotonic() < deadline:
+            info = coord.membership()
+            if victim is not None and victim in procs:
+                mem = next((m for m in info["members"]
+                            if m["member"] == victim), None)
+                if mem is not None and \
+                        int(mem["detail"].get("iteration", 0)) >= kill_iter:
+                    os.kill(procs[victim].pid, signal.SIGKILL)
+                    procs[victim].wait()
+                    verdict["killed"] = {
+                        "member": victim,
+                        "at_iteration": mem["detail"].get("iteration"),
+                        "generation": info["generation"]}
+                    print(f"[chaos] SIGKILL {victim} at iteration "
+                          f"{mem['detail'].get('iteration')} "
+                          f"(generation {info['generation']})")
+                    del procs[victim]
+                    victim = None
+                    if respawn:
+                        member = f"joiner-{respawned}"
+                        respawned += 1
+                        # the replacement joins with min_world=1: it
+                        # must merge into the live world, not gate on
+                        # the original formation size
+                        jspec = dict(spec, min_world=1)
+                        jpath = os.path.join(rundir, "spec-joiner.json")
+                        with open(jpath, "w") as f:
+                            json.dump(jspec, f, indent=1)
+                        procs[member] = _spawn(rundir, jpath, address,
+                                               member)
+                        verdict["respawned"] = member
+            if procs and all(p.poll() is not None for p in procs.values()):
+                break
+            time.sleep(0.05)
+        else:
+            verdict["errors"].append(f"timeout after {timeout_s}s")
+
+        for member, proc in procs.items():
+            rc = proc.poll()
+            if rc is None:
+                proc.kill()
+                proc.wait()
+                verdict["errors"].append(f"{member} hung; killed")
+            elif rc != 0:
+                verdict["errors"].append(f"{member} exited {rc}")
+    finally:
+        for proc in procs.values():
+            if proc.poll() is None:
+                proc.kill()
+        coord.stop()
+
+    for name in sorted(os.listdir(rundir)):
+        if name.startswith("result-") and name.endswith(".json"):
+            with open(os.path.join(rundir, name)) as f:
+                verdict["results"].append(json.load(f))
+    if not verdict["results"]:
+        verdict["errors"].append("no worker produced a result")
+    for res in verdict["results"]:
+        for key in ("model_sha256", "digest"):
+            if res[key] != want[key]:
+                verdict["errors"].append(
+                    f"{res['member']} {key} mismatch: {res[key][:12]} != "
+                    f"oracle {want[key][:12]}")
+    verdict["ok"] = not verdict["errors"]
+    return verdict
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--worker", metavar="SPEC", help=argparse.SUPPRESS)
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--shards", type=int, default=0,
+                    help="protocol shard count (default: --workers)")
+    ap.add_argument("--iters", type=int, default=8)
+    ap.add_argument("--rows", type=int, default=600)
+    ap.add_argument("--features", type=int, default=8)
+    ap.add_argument("--leaves", type=int, default=7)
+    ap.add_argument("--snapshot-freq", type=int, default=1)
+    ap.add_argument("--kill-iter", type=int, default=3,
+                    help="SIGKILL the victim when it reports this "
+                         "iteration")
+    ap.add_argument("--kill-member", type=int, default=1)
+    ap.add_argument("--no-kill", action="store_true",
+                    help="uninterrupted control run")
+    ap.add_argument("--respawn", action="store_true",
+                    help="spawn a replacement joiner after the kill")
+    ap.add_argument("--rundir")
+    ap.add_argument("--timeout", type=float, default=420.0)
+    ap.add_argument("--json", action="store_true", dest="as_json")
+    args = ap.parse_args(argv)
+
+    if args.worker:
+        return worker_main(args.worker)
+
+    verdict = run_chaos(
+        workers=args.workers, shards=args.shards, iters=args.iters,
+        rows=args.rows, features=args.features, leaves=args.leaves,
+        snapshot_freq=args.snapshot_freq,
+        kill_iter=None if args.no_kill else args.kill_iter,
+        kill_member=args.kill_member, respawn=args.respawn,
+        rundir=args.rundir, timeout_s=args.timeout)
+    if args.as_json:
+        print(json.dumps(verdict, indent=1))
+    else:
+        for err in verdict["errors"]:
+            print(f"[chaos] FAIL: {err}")
+        print(f"[chaos] {'OK' if verdict['ok'] else 'FAILED'}: "
+              f"{len(verdict['results'])} result(s), killed="
+              f"{verdict['killed']}, oracle "
+              f"{verdict['oracle']['model_sha256'][:12]}")
+    return 0 if verdict["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

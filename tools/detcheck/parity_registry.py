@@ -132,6 +132,20 @@ PROGRAM_PAIRS: Tuple[Dict, ...] = (
                   "histogram folds, host-resident scores)",
                   "resident in-memory fused training loop"),
      "test": "tests/test_streaming.py"},
+    {"name": "elastic-vs-single-process",
+     "env": "LGBM_TPU_ELASTIC",
+     "programs": ("elastic multi-host streamed training (owned-shard "
+                  "folds + allgathered partials combined in shard "
+                  "order, barrier-snapshot recovery)",
+                  "single-process streamed training at the same "
+                  "protocol shard count"),
+     "test": "tests/test_elastic.py"},
+    {"name": "elastic-shard-protocol",
+     "env": "LGBM_TPU_ELASTIC_SHARDS",
+     "programs": ("S-shard partial folds for any fixed S (the run-"
+                  "lifetime identity domain; world size and membership "
+                  "history never reach the traced programs)",),
+     "test": "tests/test_elastic.py"},
 )
 
 # knobs that branch inside jit-bearing modules but do not choose
@@ -209,6 +223,18 @@ EXEMPT_ENV: Dict[str, str] = {
                              "override (io/outofcore.py); storage "
                              "location only, the cache key still "
                              "validates content",
+    "LGBM_TPU_COLLECTIVE_DEADLINE_S": "rank-loss detection deadline on "
+                                      "host collectives (io/distributed."
+                                      "deadline_call): bounds how long "
+                                      "the HOST waits, never what the "
+                                      "device computes",
+    "LGBM_TPU_HEARTBEAT_S": "elastic heartbeat cadence (parallel/"
+                            "elastic.py); liveness signaling only, "
+                            "model state untouched",
+    "LGBM_TPU_ELASTIC_MEMBER": "elastic member identity (stable "
+                               "member id for rejoin/chaos kill "
+                               "scheduling); naming only, the rank map "
+                               "is the coordinator's",
 }
 
 # -- DET004: first-max tie-break contracts -------------------------------
