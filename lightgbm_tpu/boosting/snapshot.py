@@ -411,6 +411,26 @@ def latest_valid_barrier(prefix: str,
     return None
 
 
+def barrier_candidates(prefix: str,
+                       num_shards: Optional[int] = None) -> Dict[int, str]:
+    """``{iteration: model_sha256}`` of every barrier that validates in
+    full on THIS rank's view of shared storage.  Elastic restore
+    allgathers these and adopts the newest barrier every member can
+    see — a lagging filesystem view or a concurrent prune must never
+    let ranks resume different iterations (that desync only surfaces
+    later as a mid-train barrier-tag RuntimeError)."""
+    out: Dict[int, str] = {}
+    for it, manifest_path in list_barriers(prefix):
+        manifest = validate_barrier(manifest_path)
+        if manifest is None:
+            continue
+        if num_shards is not None \
+                and int(manifest.get("num_shards", -1)) != int(num_shards):
+            continue
+        out[int(manifest["iteration"])] = manifest["model_sha256"]
+    return out
+
+
 def prune_barriers(prefix: str, keep: int) -> None:
     """Keep the newest ``keep`` COMMITTED barriers (same retention
     rationale as :func:`prune_snapshots`); uncommitted shard residue of

@@ -861,18 +861,42 @@ class StreamTrainer:
         run.barrier(f"barrier-committed-{iteration}")
         counter_add("elastic.barriers")
 
-    def restore_barrier(self, prefix: Optional[str] = None) -> int:
+    def restore_barrier(self, prefix: Optional[str] = None,
+                        iteration: Optional[int] = None,
+                        model_sha: Optional[str] = None) -> int:
         """Adopt the newest COMMITTED barrier under ``prefix`` (trees
         from the model text, scores from the shard state files); returns
         the restored iteration, 0 when there is nothing to restore.
         Rank-oblivious by construction: every rank reads the same
         manifest, and shard states are keyed by protocol shard, not by
-        the rank that wrote them."""
-        from .snapshot import config_hash, latest_valid_barrier
+        the rank that wrote them.
+
+        ``iteration``/``model_sha`` pin the exact barrier the elastic
+        world AGREED on (the restore allgather in ``train_elastic``) —
+        a rank that cannot validate that barrier anymore fails fast
+        here instead of resuming a different iteration and desyncing
+        barrier tags mid-train."""
+        from .snapshot import (barrier_paths, config_hash,
+                               latest_valid_barrier, validate_barrier)
         prefix = prefix or self.config.output_model
-        man = latest_valid_barrier(prefix, num_shards=self.S)
-        if man is None:
-            return 0
+        if iteration is None:
+            man = latest_valid_barrier(prefix, num_shards=self.S)
+            if man is None:
+                return 0
+        else:
+            man = validate_barrier(barrier_paths(prefix,
+                                                 int(iteration))[1])
+            if man is None \
+                    or int(man.get("num_shards", -1)) != self.S \
+                    or (model_sha is not None
+                        and man.get("model_sha256") != model_sha):
+                raise RuntimeError(
+                    f"agreed barrier snapshot (iteration {iteration}) "
+                    "is no longer restorable on this rank — it "
+                    "validated during the restore allgather but is now "
+                    "missing, torn, or a different model; refusing to "
+                    "resume from a different iteration than the rest "
+                    "of the world")
         if man.get("config_hash") and \
                 man["config_hash"] != config_hash(self.config):
             raise ValueError(
@@ -982,7 +1006,7 @@ def train_elastic(params, source, num_boost_round: Optional[int] = None,
     from ..parallel.elastic import (ELASTIC_INTERRUPTS, ElasticClient,
                                     ElasticRun, EvictedError,
                                     elastic_address)
-    from .snapshot import config_hash
+    from .snapshot import barrier_candidates, config_hash
     config = Config.from_params(canonicalize_params(dict(params)))
     config.check()
     if isinstance(source, (list, tuple)):
@@ -1006,20 +1030,41 @@ def train_elastic(params, source, num_boost_round: Optional[int] = None,
                 run = ElasticRun(client, S)
                 # protocol agreement before any work: every member of
                 # this generation must train the same config with the
-                # same shard count, or the partials are meaningless
-                views = run.allgather({"shards": S, "config": chash})
-                for v in views[1:]:
-                    if v != views[0]:
+                # same shard count, or the partials are meaningless.
+                # The same allgather carries each rank's view of the
+                # committed barriers, so the world agrees on ONE
+                # restore point up front — a lagging filesystem or a
+                # concurrent prune must not let ranks resume different
+                # iterations (that desync would only surface later as
+                # a mid-train barrier-tag RuntimeError).
+                cands = barrier_candidates(config.output_model,
+                                           num_shards=S)
+                views = run.allgather({
+                    "shards": S, "config": chash,
+                    "barriers": {str(i): sha
+                                 for i, sha in cands.items()}})
+                proto = [{k: v for k, v in view.items()
+                          if k != "barriers"} for view in views]
+                for v in proto[1:]:
+                    if v != proto[0]:
                         raise RuntimeError(
                             "elastic members disagree on the protocol "
-                            f"({views}); every member must train the "
+                            f"({proto}); every member must train the "
                             "same params with the same shard count")
+                common = set(views[0].get("barriers", {}).items())
+                for v in views[1:]:
+                    common &= set(v.get("barriers", {}).items())
+                agreed = (max(common, key=lambda kv: int(kv[0]))
+                          if common else None)
                 with obs_span("elastic.reshard", world=run.world,
                               generation=run.generation, shards=S):
                     trainer = StreamTrainer(config, source,
                                             block_rows=block_rows,
                                             num_shards=S, elastic=run)
-                    it0 = trainer.restore_barrier()
+                    it0 = (trainer.restore_barrier(
+                               iteration=int(agreed[0]),
+                               model_sha=agreed[1])
+                           if agreed else 0)
                 if it0:
                     log_info(f"elastic: resuming from barrier iteration "
                              f"{it0} as rank {run.rank}/{run.world} "

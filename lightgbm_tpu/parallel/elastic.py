@@ -154,9 +154,16 @@ class ElasticCoordinator:
         self._generation = 0
         self._join_seq = 0
         # (generation, seq) -> {rank: payload}; results cached until the
-        # last member of the round has read them
+        # last member of the round has read them.  _touch records each
+        # round's last contribution: a legitimate round completes and
+        # drains within one client deadline of it, so a round idle for
+        # several deadlines was abandoned (its members timed out
+        # client-side and retry under fresh keys after resync) and the
+        # monitor ages it out to keep coordinator memory bounded.
         self._rounds: Dict[Tuple[int, int], Dict[int, Any]] = {}
         self._reads: Dict[Tuple[int, int], int] = {}
+        self._touch: Dict[Tuple[int, int], float] = {}
+        self._deadline_hint = 0.0    # max client deadline seen on the wire
         self._stop = False
         coord = self
 
@@ -240,6 +247,8 @@ class ElasticCoordinator:
                         if k[0] >= self._generation}
         self._reads = {k: v for k, v in self._reads.items()
                        if k[0] >= self._generation}
+        self._touch = {k: v for k, v in self._touch.items()
+                       if k[0] >= self._generation}
         counter_add("elastic.generation_bumps")
         event("elastic", why, generation=self._generation,
               world=len(self._members), **attrs)
@@ -261,6 +270,18 @@ class ElasticCoordinator:
                                   key=lambda m: m.joined_seq)
                     if live:
                         dead = [live[-1]]
+                # age out abandoned rounds: every contributor gives up
+                # at most one client deadline after its contribution,
+                # so a round idle for several deadlines has no live
+                # client left (survivors retry under fresh keys)
+                stale_after = max(self._deadline_hint * 3,
+                                  self.heartbeat_timeout_s * 4, 2.0)
+                for key in [k for k, ts in self._touch.items()
+                            if now - ts > stale_after]:
+                    self._rounds.pop(key, None)
+                    self._reads.pop(key, None)
+                    self._touch.pop(key, None)
+                    counter_add("elastic.rounds_aged_out")
                 for m in dead:
                     ranks = self._ranks()
                     lost_rank = ranks.get(m.member, -1)
@@ -359,8 +380,14 @@ class ElasticCoordinator:
                         "generation": self._generation}
             ranks = self._ranks()
             world = len(ranks)
+            try:
+                self._deadline_hint = max(self._deadline_hint,
+                                          float(req.get("deadline_s") or 0))
+            except (TypeError, ValueError):
+                pass
             parts = self._rounds.setdefault(key, {})
             parts[ranks[member]] = req.get("payload")
+            self._touch[key] = time.monotonic()
             self._cv.notify_all()
             while True:
                 if self._stop:
@@ -377,6 +404,7 @@ class ElasticCoordinator:
             if self._reads[key] >= world:
                 self._rounds.pop(key, None)
                 self._reads.pop(key, None)
+                self._touch.pop(key, None)
             return {"ok": True, "payloads": payloads}
 
 
@@ -412,6 +440,10 @@ class ElasticClient:
         self.world = 0
         self.rank = -1
         self.generation = -1
+        # churn the heartbeat thread has SEEN but this client has not
+        # yet adopted; only _adopt mutates (generation, seq) — the pair
+        # keys collective rounds and must move together on every member
+        self._seen_generation = -1
         self.seq = 0
         self._status: Dict[str, Any] = {}
         self._hb_thread: Optional[threading.Thread] = None
@@ -439,6 +471,17 @@ class ElasticClient:
             counter_add("collective.deadline_exceeded")
             event("elastic", "rank_lost", site=site, deadline_s=timeout)
             raise RankLostError(site, timeout) from None
+        except (OSError, ValueError) as exc:
+            # reset/refused/broken-pipe from a coordinator hiccup, or a
+            # truncated JSON line: every transport failure funnels into
+            # the typed recovery path (train_elastic catches
+            # ELASTIC_INTERRUPTS, not raw socket errors)
+            counter_add("elastic.transport_errors")
+            event("elastic", "rank_lost", site=site, deadline_s=timeout,
+                  error=type(exc).__name__)
+            raise RankLostError(
+                site, timeout,
+                f"transport failure {type(exc).__name__}: {exc}") from None
 
     def _check(self, resp: Dict[str, Any]) -> Dict[str, Any]:
         if resp.get("ok"):
@@ -490,9 +533,21 @@ class ElasticClient:
     def _adopt(self, resp: Dict[str, Any]) -> None:
         self.world = int(resp["world"])
         self.rank = int(resp["rank"])
-        if int(resp["generation"]) != self.generation:
-            self.seq = 0
         self.generation = int(resp["generation"])
+        self._seen_generation = self.generation
+        # unconditional: every member re-adopts after an interrupt, so
+        # resetting only on a generation change would leave a member
+        # whose view was already current (e.g. the heartbeat saw the
+        # bump first) keyed off its peers' (generation, seq) forever
+        self.seq = 0
+
+    @property
+    def observed_generation(self) -> int:
+        """The newest generation this process has any evidence of —
+        adopted (collectives run under it) or merely seen by the
+        heartbeat thread (collectives of the adopted generation are
+        doomed; :class:`ElasticRun` fails them eagerly)."""
+        return max(self.generation, self._seen_generation)
 
     def leave(self) -> None:
         self._hb_stop.set()
@@ -520,7 +575,7 @@ class ElasticClient:
         resp = self._check(self._rpc(
             {"op": "allgather", "member": self.member,
              "generation": self.generation, "seq": self.seq,
-             "payload": obj}))
+             "deadline_s": self.deadline_s, "payload": obj}))
         return resp["payloads"]
 
     def barrier(self, tag: str) -> None:
@@ -573,9 +628,12 @@ class ElasticClient:
                 continue            # next beat retries; eviction is the
                 #                     coordinator's judgement, not ours
             if resp.get("ok"):
-                # learn of membership churn between collectives
-                self.generation = max(self.generation,
-                                      int(resp.get("generation", -1)))
+                # observe membership churn between collectives; the
+                # client ADOPTS it only via resync/_adopt (which also
+                # resets seq — the two must never move separately)
+                self._seen_generation = max(self._seen_generation,
+                                            int(resp.get("generation",
+                                                         -1)))
 
 
 class ElasticRun:
@@ -601,13 +659,13 @@ class ElasticRun:
                      if s % self.world == self.rank)
 
     def allgather(self, obj: Any) -> List[Any]:
-        if self.client.generation != self.generation:
-            raise GenerationChanged(self.client.generation,
-                                    "membership moved under this run")
+        g = self.client.observed_generation
+        if g != self.generation:
+            raise GenerationChanged(g, "membership moved under this run")
         return self.client.allgather(obj)
 
     def barrier(self, tag: str) -> None:
-        if self.client.generation != self.generation:
-            raise GenerationChanged(self.client.generation,
-                                    "membership moved under this run")
+        g = self.client.observed_generation
+        if g != self.generation:
+            raise GenerationChanged(g, "membership moved under this run")
         self.client.barrier(tag)

@@ -152,6 +152,10 @@ def test_allgather_rank_ordered_and_barrier():
         b = _client(coord, "b")
         try:
             ta, boxa = _in_thread(a.join_world, 2)
+            deadline = time.monotonic() + 10.0
+            while coord.membership()["world"] < 1 \
+                    and time.monotonic() < deadline:
+                time.sleep(0.01)   # a registers first: ranks by join order
             tb, boxb = _in_thread(b.join_world, 2)
             ta.join(10)
             tb.join(10)
@@ -198,6 +202,111 @@ def test_generation_change_fails_inflight_collective():
         finally:
             a.close()
             b.close()
+
+
+def test_resync_realigns_seq_after_heartbeat_observed_churn():
+    """REVIEW regression (world >= 2): a survivor whose HEARTBEAT
+    already saw the new generation must still reset its collective
+    sequence on resync, exactly like peers that learn of the churn at
+    resync time — otherwise (generation, seq) keys permanently
+    disagree and every post-recovery collective blocks to its
+    deadline."""
+    with _coord() as coord:
+        a = _client(coord, "a", hb=0.05)
+        b = _client(coord, "b", hb=0.05)
+        try:
+            ta, _ = _in_thread(a.join_world, 2)
+            tb, _ = _in_thread(b.join_world, 2)
+            ta.join(10)
+            tb.join(10)
+            gen = a.generation
+            # only a's heartbeat observes the coming churn
+            b.pause_heartbeats(True)
+            ta, _ = _in_thread(a.allgather, 1)
+            tb, _ = _in_thread(b.allgather, 2)
+            ta.join(10)
+            tb.join(10)
+            assert a.seq == b.seq == 1
+            intruder = _client(coord, "intruder")
+            intruder.join_world()
+            intruder.leave()
+            intruder.close()
+            deadline = time.monotonic() + 5.0
+            while a.observed_generation <= gen \
+                    and time.monotonic() < deadline:
+                time.sleep(0.02)
+            # observed ahead of adopted: collectives of the adopted
+            # generation are doomed and ElasticRun fails them eagerly
+            assert a.observed_generation > gen
+            assert a.generation == gen
+            a.resync()
+            b.resync()
+            b.pause_heartbeats(False)
+            assert a.generation == b.generation > gen
+            assert a.seq == 0 and b.seq == 0
+            # the proof: a post-recovery collective completes
+            ta, boxa = _in_thread(a.allgather, "a")
+            tb, boxb = _in_thread(b.allgather, "b")
+            ta.join(10)
+            tb.join(10)
+            assert boxa["value"] == ["a", "b"] == boxb["value"]
+        finally:
+            a.close()
+            b.close()
+
+
+def test_transport_failures_raise_ranklost():
+    """REVIEW regression: a coordinator hiccup (refused/reset
+    connection) surfaces as the typed RankLostError the recovery loop
+    catches, never as a raw OSError that crashes the worker."""
+    coord = ElasticCoordinator()
+    coord.start()
+    addr = coord.address
+    c = ElasticClient(addr, member="m", deadline_s=2.0)
+    c.join_world()
+    coord.stop()
+    try:
+        with pytest.raises(RankLostError):
+            c.allgather("x")
+    finally:
+        c.close()
+    s = obs.summary()
+    assert s["counters"].get("elastic.transport_errors", 0) \
+        + s["counters"].get("collective.deadline_exceeded", 0) >= 1
+
+
+def test_coordinator_ages_out_abandoned_rounds():
+    """REVIEW regression: a round abandoned client-side (a member
+    timed out and will retry under fresh keys after resync) must not
+    pin its payloads in coordinator memory forever."""
+    with _coord(heartbeat_timeout_s=0.4) as coord:
+        a = _client(coord, "a", deadline_s=0.3, hb=0.05)
+        b = _client(coord, "b", deadline_s=0.3, hb=0.05)
+        try:
+            ta, _ = _in_thread(a.join_world, 2)
+            tb, _ = _in_thread(b.join_world, 2)
+            ta.join(10)
+            tb.join(10)
+            # a contributes alone and gives up at its deadline; the
+            # incomplete round stays keyed (generation, 1)
+            with pytest.raises(RankLostError):
+                a.allgather("only-me")
+            with coord._cv:
+                assert len(coord._rounds) == 1
+            deadline = time.monotonic() + 10.0
+            while time.monotonic() < deadline:
+                with coord._cv:
+                    if not coord._rounds and not coord._touch:
+                        break
+                time.sleep(0.05)
+            with coord._cv:
+                assert not coord._rounds and not coord._reads \
+                    and not coord._touch
+        finally:
+            a.close()
+            b.close()
+    s = obs.summary()
+    assert s["counters"].get("elastic.rounds_aged_out", 0) >= 1
 
 
 def test_hung_collective_raises_ranklost_within_deadline():
