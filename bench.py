@@ -1334,7 +1334,8 @@ def stream_child():
 ELASTIC_SCHEMA_KEYS = (
     "elastic_workers", "elastic_shards", "elastic_iters",
     "elastic_kill_iter", "elastic_respawned", "elastic_recovery_ok",
-    "elastic_identity_ok", "elastic_wall_s", "elastic_oracle_sha256")
+    "elastic_identity_ok", "elastic_wall_s", "elastic_oracle_sha256",
+    "elastic_mttr_s", "elastic_mttr_phases")
 
 
 def elastic_leg(line=None, dryrun: bool = False):
@@ -1412,6 +1413,12 @@ def elastic_leg(line=None, dryrun: bool = False):
         "elastic_wall_s": round(time.time() - t0, 3),
         "elastic_oracle_sha256": verdict.get("oracle", {}).get(
             "model_sha256", ""),
+        # MTTR (ISSUE 17): the slowest survivor-recorded recovery
+        # episode; phases (detect/resync/reshard/restore/retrain)
+        # sum to mttr_s by construction — the chaos verdict enforces it
+        "elastic_mttr_s": verdict.get("mttr_s", 0.0),
+        "elastic_mttr_phases": verdict.get("recovery", {}).get(
+            "phases", {}),
     }
     if verdict.get("errors"):
         out["elastic_errors"] = verdict["errors"]
@@ -1696,11 +1703,21 @@ def dryrun_main():
         el = elastic_leg(dryrun=True)
         missing = [k for k in ELASTIC_SCHEMA_KEYS if k not in el]
         line.update(el)
+        # MTTR gate (ISSUE 17): a killed run must carry a positive
+        # recovery time whose phase breakdown sums to it exactly
+        phases = el.get("elastic_mttr_phases") or {}
+        mttr_ok = bool(
+            el.get("elastic_mttr_s", 0) > 0 and phases
+            and abs(sum(phases.values())
+                    - el["elastic_mttr_s"]) < 1e-9)
         line["elastic_ok"] = bool(
             not missing
             and el["elastic_identity_ok"]
             and el["elastic_recovery_ok"]
-            and el["elastic_wall_s"] > 0)
+            and el["elastic_wall_s"] > 0
+            and mttr_ok)
+        if not mttr_ok:
+            line["elastic_mttr_ok"] = False
         if missing:
             line["elastic_schema_missing"] = missing
     except Exception as exc:        # noqa: BLE001 - reported on the line

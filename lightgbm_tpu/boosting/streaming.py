@@ -292,6 +292,10 @@ class StreamTrainer:
         self.scores = np.zeros((n, self.K), np.float32)
         self._init_scores()
         self._jits = {}
+        # open MTTR episode handed over by train_elastic after a
+        # recovery: train() closes it (phase `retrain`) once boosting
+        # re-reaches the iteration the failure interrupted
+        self.recovery_episode = None
 
     # -- init ------------------------------------------------------------
     def _init_scores(self) -> None:
@@ -571,8 +575,10 @@ class StreamTrainer:
         start = self.booster.iter
         with obs_span("stream.train", rows=self.n, block=self.R,
                       shards=self.S):
+            self._finish_recovery()
             for it in range(start, iters):
                 stopped = self._train_one_iter(it)
+                self._finish_recovery()
                 if stopped:
                     break
                 if self.elastic is not None:
@@ -580,11 +586,26 @@ class StreamTrainer:
                     # chaos launcher's kill scheduler) see it in info()
                     self.elastic.client.set_status(iteration=it + 1)
                     self._maybe_barrier(it + 1)
+        ep = self.recovery_episode
+        if ep is not None:
+            # early stop before the failure iteration came back around:
+            # close the episode at the point training actually ended
+            self.recovery_episode = None
+            ep.finish(iteration=int(self.booster.iter), truncated=True)
         if self.elastic is not None and self.elastic.world > 1:
             self._sync_scores()
         self.booster.scores = self.scores     # host state IS the digest
         self.booster.trim_trailing_stumps()
         return self.booster
+
+    def _finish_recovery(self) -> None:
+        """Close the open recovery episode once boosting has re-reached
+        the iteration the failure interrupted — `retrain` ends at full
+        recovery, not at re-rendezvous."""
+        ep = self.recovery_episode
+        if ep is not None and self.booster.iter >= ep.target_iter:
+            self.recovery_episode = None
+            ep.finish(iteration=int(self.booster.iter))
 
     def _train_one_iter(self, it: int) -> bool:
         c = self.config
@@ -693,7 +714,8 @@ class StreamTrainer:
                 part = jnp.stack(reduce_chunk_sums(
                     jnp.asarray(cs[:, :m_chunks])))
                 payload[str(s)] = np.asarray(part)
-            merged = self._exchange_arrays(payload)
+            merged = self._exchange_arrays(payload,
+                                           site="elastic.root_stats")
             parts = [jnp.asarray(merged[s]) for s in range(self.S)]
             tot = parts[0] if self.S == 1 else combine(parts)
             state = init_state(tot[:, None])   # [3, 1]: identity reduce
@@ -736,7 +758,8 @@ class StreamTrainer:
                 # runs); combining the gathered partials in shard order
                 # IS the single-process combine below, bitwise
                 merged = self._exchange_arrays(
-                    {str(s): np.asarray(accs[s]) for s in self.owned})
+                    {str(s): np.asarray(accs[s]) for s in self.owned},
+                    site="elastic.wave_hist")
                 parts = [jnp.asarray(merged[s]) for s in range(self.S)]
                 new_h = parts[0] if self.S == 1 else combine(parts)
             else:
@@ -780,14 +803,18 @@ class StreamTrainer:
         return int(nl)
 
     # -- elastic protocol -------------------------------------------------
-    def _exchange_arrays(self, payload) -> dict:
+    def _exchange_arrays(self, payload,
+                         site: str = "elastic.exchange") -> dict:
         """Allgather ``{shard: array}`` contributions and return the
         full ``{shard: array}`` map — every protocol shard must be
         covered (the mod-world ownership rule guarantees it; a hole
-        means a protocol desync, not a recoverable fault)."""
+        means a protocol desync, not a recoverable fault).  ``site``
+        names the call point on the collective's trace span — the
+        straggler table is per-site, so root-stat, wave-histogram and
+        score-sync skew attribute separately."""
         from ..parallel.elastic import decode_array, encode_array
         gathered = self.elastic.allgather(
-            {s: encode_array(a) for s, a in payload.items()})
+            {s: encode_array(a) for s, a in payload.items()}, site=site)
         merged = {}
         for part in gathered:
             merged.update(part or {})
@@ -829,7 +856,8 @@ class StreamTrainer:
         digest = hashlib.sha256(model_text.encode()).hexdigest()
         acks = run.allgather({
             "iteration": int(iteration), "digest": digest,
-            "shards": {str(s): sha for s, sha in shard_shas.items()}})
+            "shards": {str(s): sha for s, sha in shard_shas.items()}},
+            site="elastic.barrier_commit")
         head = (acks[0]["iteration"], acks[0]["digest"])
         for a in acks[1:]:
             if (a["iteration"], a["digest"]) != head:
@@ -946,7 +974,7 @@ class StreamTrainer:
             lo, hi = self.ranges[s]
             hi = min(hi, self.n)
             payload[str(s)] = self.scores[lo:hi]
-        merged = self._exchange_arrays(payload)
+        merged = self._exchange_arrays(payload, site="elastic.score_sync")
         for s in range(self.S):
             lo, hi = self.ranges[s]
             hi = min(hi, self.n)
@@ -980,6 +1008,40 @@ def elastic_shards(world: int, explicit: int = 0) -> int:
     s = int(explicit) or int(os.environ.get("LGBM_TPU_ELASTIC_SHARDS",
                                             "0") or 0)
     return s if s > 0 else max(int(world), 1)
+
+
+def _write_elastic_summary(run) -> None:
+    """Train-end merged telemetry summary over the ELASTIC allgather
+    (elastic workers are not a jax multi-process world, so the
+    ``cli.py`` ``jax_process_allgather`` route never fires for them):
+    rank 0 writes ``<trace>.summary.json`` next to its trace file.
+
+    The merge collective is gated only on shared state (``run.world``)
+    — every rank participates or none does; whether a rank traces is a
+    local decision applied AFTER the gather.  A peer lost between
+    train end and here must not restart recovery over a summary, so
+    elastic interrupts are swallowed (the trained model already
+    returned on every rank's success path)."""
+    import re
+    from ..obs import merged_summary, write_summary
+    from ..obs import telemetry
+    from ..parallel.elastic import ELASTIC_INTERRUPTS
+    try:
+        merged = (merged_summary(
+                      lambda obj: run.allgather(obj,
+                                                site="elastic.summary"))
+                  if run.world > 1 else None)
+    except ELASTIC_INTERRUPTS:
+        return
+    path = telemetry.trace_path()
+    if not path or (run.world > 1 and run.rank != 0):
+        return
+    base = re.sub(r"\.rank\d+$", "", path)
+    try:
+        write_summary(base + ".summary.json", merged)
+    except OSError:
+        log_warning("elastic: failed to write merged summary "
+                    f"({base}.summary.json)")
 
 
 def train_elastic(params, source, num_boost_round: Optional[int] = None,
@@ -1020,8 +1082,21 @@ def train_elastic(params, source, num_boost_round: Optional[int] = None,
                 "elastic training needs a coordinator: pass "
                 "coordinator='host:port' or set LGBM_TPU_ELASTIC")
         client = ElasticClient(addr)
+    episode = None           # open MTTR episode (obs/fleet.py)
+    trainer = None
     try:
-        world, _, _ = client.join_world(min_world=min_world)
+        # records emitted during the rendezvous must not open the trace
+        # file before this process knows its ELASTIC rank (same
+        # discipline as mesh.init_distributed); set_rank makes the
+        # coordinator's rank/world the trace identity — each elastic
+        # worker is a world-1 jax process
+        from ..obs.telemetry import hold_trace, release_trace, set_rank
+        hold_trace()
+        try:
+            world, _, _ = client.join_world(min_world=min_world)
+            set_rank(client.rank, client.world)
+        finally:
+            release_trace()
         S = elastic_shards(world, num_shards)
         chash = config_hash(config)
         recoveries = 0
@@ -1042,7 +1117,8 @@ def train_elastic(params, source, num_boost_round: Optional[int] = None,
                 views = run.allgather({
                     "shards": S, "config": chash,
                     "barriers": {str(i): sha
-                                 for i, sha in cands.items()}})
+                                 for i, sha in cands.items()}},
+                    site="elastic.protocol")
                 proto = [{k: v for k, v in view.items()
                           if k != "barriers"} for view in views]
                 for v in proto[1:]:
@@ -1061,21 +1137,53 @@ def train_elastic(params, source, num_boost_round: Optional[int] = None,
                     trainer = StreamTrainer(config, source,
                                             block_rows=block_rows,
                                             num_shards=S, elastic=run)
+                    if episode is not None:
+                        episode.mark("reshard")
                     it0 = (trainer.restore_barrier(
                                iteration=int(agreed[0]),
                                model_sha=agreed[1])
                            if agreed else 0)
+                    if episode is not None:
+                        episode.mark("restore")
                 if it0:
                     log_info(f"elastic: resuming from barrier iteration "
                              f"{it0} as rank {run.rank}/{run.world} "
                              f"(generation {run.generation})")
+                if episode is not None:
+                    # the trainer closes it (phase `retrain`) when
+                    # boosting re-reaches the interrupted iteration
+                    trainer.recovery_episode = episode
+                    episode = None
                 health.mark_ready()
-                return trainer.train(num_boost_round)
+                booster = trainer.train(num_boost_round)
+                _write_elastic_summary(run)
+                return booster
             except ELASTIC_INTERRUPTS as exc:
                 recoveries += 1
                 if recoveries > max_recoveries:
                     raise
                 counter_add("elastic.recoveries")
+                # MTTR accounting: a new episode opens at the moment
+                # the failed collective STARTED stalling (the consumed
+                # client.op_started) — the deadline wait is the
+                # `detect` phase.  A repeat interrupt subsumes any
+                # episode still open from the previous attempt.
+                from ..obs import fleet
+                stall = client.op_started
+                client.op_started = None
+                if episode is not None:
+                    episode.abandon()
+                if trainer is not None \
+                        and trainer.recovery_episode is not None:
+                    trainer.recovery_episode.abandon()
+                    trainer.recovery_episode = None
+                episode = fleet.RecoveryEpisode(
+                    error=type(exc).__name__,
+                    generation=int(client.generation),
+                    target_iter=(trainer.booster.iter
+                                 if trainer is not None else 0),
+                    stall_started=stall)
+                episode.mark("detect")
                 health.mark_recovering(reason=type(exc).__name__)
                 with obs_span("elastic.recover",
                               error=type(exc).__name__):
@@ -1089,6 +1197,8 @@ def train_elastic(params, source, num_boost_round: Optional[int] = None,
                             client.resync()
                         except ELASTIC_INTERRUPTS:
                             client.join_world(min_world=1)
+                set_rank(client.rank, client.world)
+                episode.mark("resync")
                 continue
     finally:
         if own_client:

@@ -148,10 +148,14 @@ def jax_process_allgather(obj) -> List[object]:
     blip during a week-long run must not kill it); the
     ``collective.allgather`` fault point sits in front for the
     robustness tests."""
-    from ..obs import span
+    from ..obs import enabled as obs_enabled
+    from ..obs import fleet, span
     from ..obs.flight_recorder import record as fr_record
     from ..utils.faults import fault_point
     from ..utils.retry import retry_call
+
+    site = "io.distributed.jax_process_allgather"
+    info: dict = {}
 
     def _gather():
         fault_point("collective.allgather")
@@ -159,30 +163,62 @@ def jax_process_allgather(obj) -> List[object]:
         from jax.experimental import multihost_utils
         payload = json.dumps(obj).encode()
         n = np.frombuffer(payload, np.uint8)
+        # the size row doubles as the arrival stamp — [nbytes, entry_us]
+        # rides the int64 gather every rank already issues, so the
+        # collective schedule is unchanged and max(entry) - mine is this
+        # rank's arrival skew (raw wall clocks; the fleet report applies
+        # clk_off_s when it folds ranks onto one timeline)
+        # detcheck: disable=DET006 -- arrival stamp is observability metadata; it rides the gather but never feeds a traced computation
+        entry_us = int(time.time() * 1e6)
         sizes = multihost_utils.process_allgather(
-            np.array([len(n)], np.int64))
-        cap = int(sizes.max())
+            np.array([len(n), entry_us], np.int64))
+        sz = np.asarray(sizes).reshape(-1, 2)
+        cap = int(sz[:, 0].max())
         padded = np.zeros(cap, np.uint8)
         padded[:len(n)] = n
         gathered = multihost_utils.process_allgather(padded)
-        szs = np.asarray(sizes).reshape(-1)
-        g = np.asarray(gathered).reshape(len(szs), cap)
-        return [json.loads(bytes(g[r, :int(szs[r])]).decode())
-                for r in range(len(szs))]
+        g = np.asarray(gathered).reshape(len(sz), cap)
+        info["bytes"] = int(len(n))
+        info["entry_us"] = [int(v) for v in sz[:, 1]]
+        info["my_us"] = entry_us
+        # tpulint: disable=TPL001 -- process_index() is a host-side int, not a traced array
+        info["rank"] = int(jax.process_index())
+        return [json.loads(bytes(g[r, :int(sz[r, 0])]).decode())
+                for r in range(len(sz))]
 
     # one fingerprint per LOGICAL collective (outside the retry loop: a
     # retried rank joins the same collective late, it does not issue a
     # new one); payload sizes legitimately differ per rank, so only the
     # site+op enter the fingerprint
-    fr_record("io.distributed.jax_process_allgather", "process_allgather")
+    fr_record(site, "process_allgather")
+    # (site, seq) is the cross-rank join key: per-site counters advance
+    # in lockstep because every rank runs the same collective schedule
+    seq = fleet.next_seq(site)
     # span around the WHOLE retried call: collective wall-clock in the
     # run summary includes retries + backoff (what the run actually paid)
     # — under the deadline (RankLostError is not transient, so it cuts
     # through the retry policy instead of burning deadline x attempts)
-    with span("collective.allgather"):
-        return deadline_call(
+    with span("collective.allgather", site=site, seq=seq) as sp:
+        t0 = time.perf_counter()
+        out = deadline_call(
             lambda: retry_call(_gather, what="collective.allgather"),
-            "io.distributed.jax_process_allgather")
+            site)
+        dur = time.perf_counter() - t0
+        ents = info.get("entry_us")
+        if ents:
+            last = max(ents)
+            wait = max((last - info["my_us"]) / 1e6, 0.0)
+            straggler = ents.index(last)
+            sp["bytes"] = info["bytes"]
+            sp["wait_s"] = round(wait, 6)
+            sp["xfer_s"] = round(max(dur - wait, 0.0), 6)
+            sp["arrive_ts"] = info["my_us"] / 1e6
+            sp["straggler_rank"] = straggler
+            if obs_enabled():
+                fleet.note_collective(site, -1, seq, wait,
+                                      max(dur - wait, 0.0), info["bytes"],
+                                      straggler == info["rank"])
+    return out
 
 
 class ExternalCollectives:
@@ -284,11 +320,13 @@ def find_bins_distributed(X_local: np.ndarray,
     a retried rank simply joins the collective late (the
     ThreadedAllgather barrier and the reference's blocking sockets both
     tolerate that)."""
-    from ..obs import span
+    from ..obs import enabled as obs_enabled
+    from ..obs import fleet, span
     from ..obs.flight_recorder import record as fr_record
     from ..utils.faults import fault_point
     from ..utils.retry import retrying
     inner = allgather
+    site = "io.distributed.binfind_allgather"
 
     def _ag(obj):
         fault_point("collective.allgather")
@@ -298,12 +336,45 @@ def find_bins_distributed(X_local: np.ndarray,
 
     # distinct span name: with the jax backend injected the transport
     # op times itself under "collective.allgather"; this one must not
-    # double-count into the same bucket
+    # double-count into the same bucket.  The payload rides wrapped as
+    # {"_fleet_us": <entry wall-clock>, "o": obj} — every backend
+    # (threaded / external-C / jax) passes dicts through unchanged, so
+    # each rank learns the full arrival spread from the gather itself
     def allgather(obj):
-        fr_record("io.distributed.binfind_allgather", "allgather")
-        with span("collective.binfind"):
-            return deadline_call(lambda: _retry_ag(obj),
-                                 "io.distributed.binfind_allgather")
+        fr_record(site, "allgather")
+        seq = fleet.next_seq(site)
+        # detcheck: disable=DET006 -- arrival stamp is observability metadata; it rides the gather but never feeds a traced computation
+        entry_us = int(time.time() * 1e6)
+        with span("collective.binfind", site=site, seq=seq) as sp:
+            # detcheck: disable=DET006 -- host-side span timing for the wait/xfer split; pure observability
+            t0 = time.perf_counter()
+            parts = deadline_call(
+                lambda: _retry_ag({"_fleet_us": entry_us, "o": obj}),
+                site)
+            # detcheck: disable=DET006 -- host-side span timing for the wait/xfer split; pure observability
+            dur = time.perf_counter() - t0
+            try:
+                ents = [int(p["_fleet_us"]) for p in parts]
+                objs = [p["o"] for p in parts]
+            except (TypeError, KeyError, ValueError):
+                return parts    # a backend that rewrites payloads
+            last = max(ents)
+            wait = max((last - entry_us) / 1e6, 0.0)
+            straggler = ents.index(last)
+            sp["wait_s"] = round(wait, 6)
+            sp["xfer_s"] = round(max(dur - wait, 0.0), 6)
+            sp["arrive_ts"] = entry_us / 1e6
+            sp["straggler_rank"] = straggler
+            if obs_enabled():
+                try:
+                    nbytes = len(json.dumps(obj).encode())
+                except (TypeError, ValueError):
+                    nbytes = -1
+                sp["bytes"] = nbytes
+                fleet.note_collective(site, -1, seq, wait,
+                                      max(dur - wait, 0.0), nbytes,
+                                      straggler == rank)
+        return objs
     cat_set = set(int(c) for c in categorical_features)
     # 1. sync feature count to the min across ranks (:821)
     counts = allgather(int(X_local.shape[1]))

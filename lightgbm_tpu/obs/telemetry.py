@@ -54,6 +54,7 @@ __all__ = [
     "enabled", "enable", "disable", "reset", "span", "counter_add",
     "gauge_set", "event", "summary", "merged_summary", "write_summary",
     "trace_path", "set_section", "set_annotator", "set_sink",
+    "set_clock_offset", "set_rank",
 ]
 
 _lock = threading.RLock()
@@ -85,6 +86,31 @@ _annotator = None
 # module-attribute read on the already-enabled path; the disabled path
 # never reaches it — the PR 2 no-op envelope is untouched
 _sink = None
+# coordinator-clock offset of this rank (obs/fleet.py): when set, every
+# trace record carries it as `clk_off_s` so tools/fleet_report.py can
+# merge per-rank traces onto one clock (corrected_ts = ts + clk_off_s).
+# None (the default) adds nothing — single-host traces are unchanged
+_clk_off: Optional[float] = None
+# (rank, world) override for fleets that are NOT a jax multi-process
+# world (elastic workers: each is a world-1 jax process, but the
+# ELASTIC rank/world decide trace-file suffixes and summary identity)
+_rank_override = None
+
+
+def set_clock_offset(offset_s: Optional[float]) -> None:
+    """Install this rank's coordinator-clock offset (``obs/fleet.py``
+    owns the estimation); ``None`` removes the stamp."""
+    global _clk_off
+    _clk_off = None if offset_s is None else float(offset_s)
+
+
+def set_rank(rank: int, world: int) -> None:
+    """Override the (rank, world) identity used for trace-record rank
+    stamps, per-rank trace-file suffixes, and summaries.  Elastic
+    training calls this after join/resync — its ranks come from the
+    coordinator, not from jax.distributed."""
+    global _rank_override
+    _rank_override = (int(rank), max(int(world), 1))
 
 
 def set_annotator(fn) -> None:
@@ -105,7 +131,11 @@ def set_sink(sink) -> None:
 def _rank_world():
     """(rank, world) without initializing any jax backend: reads the
     distributed client state only when jax is already imported (the
-    same best-effort probe the CLI's already-meshed check uses)."""
+    same best-effort probe the CLI's already-meshed check uses).  An
+    elastic :func:`set_rank` override wins — those workers are world-1
+    jax processes whose fleet identity lives with the coordinator."""
+    if _rank_override is not None:
+        return _rank_override
     jx = sys.modules.get("jax")
     if jx is None:
         return 0, 1
@@ -157,12 +187,14 @@ def reset() -> None:
     """Clear the run summary and forget any requested trace (tests).
     Also rewinds the collective flight recorder — a fresh run must not
     inherit the previous run's schedule digest."""
-    global _trace_requested, _held, _annotator
+    global _trace_requested, _held, _annotator, _clk_off, _rank_override
     with _lock:
         disable()
         _trace_requested = None
         _held = None
         _annotator = None
+        _clk_off = None
+        _rank_override = None
         _spans.clear()
         _counters.clear()
         _gauges.clear()
@@ -176,6 +208,8 @@ def reset() -> None:
     profiler.reset()
     from . import health
     health.reset()
+    from . import fleet
+    fleet.reset()
 
 
 def trace_path() -> Optional[str]:
@@ -228,6 +262,8 @@ def _trace_write(record: Dict[str, Any]) -> None:
     opens lazily so multi-host runs that enable telemetry before
     ``jax.distributed.initialize`` still get per-rank files."""
     global _trace_file, _trace_open_path
+    if _clk_off is not None and "clk_off_s" not in record:
+        record["clk_off_s"] = _clk_off
     if _held is not None:
         _held.append(record)
         return
@@ -424,8 +460,10 @@ def summary() -> Dict[str, Any]:
     digest) so any cross-rank summary merge doubles as a schedule
     cross-check (see :func:`merged_summary`)."""
     rank, world = _rank_world()
-    from . import flight_recorder
+    from . import fleet, flight_recorder
     fr = flight_recorder.snapshot()
+    sk = fleet.skew_snapshot()
+    ck = fleet.clock()
     with _lock:
         out = {
             "rank": rank,
@@ -438,6 +476,10 @@ def summary() -> Dict[str, Any]:
         }
         if fr["count"]:
             out["flight_recorder"] = fr
+        if sk is not None:
+            out["collective_skew"] = sk
+        if ck.get("offset_s") is not None:
+            out["clock"] = ck
         out.update(_sections)
         return out
 
@@ -473,6 +515,12 @@ def merged_summary(allgather) -> Dict[str, Any]:
     check = flight_recorder.cross_check_summaries(locals_)
     if check is not None:
         merged["flight_recorder_check"] = check
+    # per-site collective arrival skew lifted fleet-wide: each rank's
+    # wait totals side by side, plus the dominant straggler per site
+    from . import fleet
+    skew = fleet.merge_skew(locals_)
+    if skew is not None:
+        merged["collective_skew"] = skew
     # per-rank health state, first-class (the ranks already carry their
     # full `health` sections; the lift makes the fleet view one read):
     # `worst` is what a supervisor should act on

@@ -40,7 +40,9 @@ Fault points (``utils/faults.py``): ``rendezvous.drop_rank`` makes the
 coordinator's monitor evict the newest member (a lost rank without
 killing a process), ``heartbeat.miss`` makes a client skip beats,
 ``collective.hang`` (in ``io/distributed.deadline_call``) stalls a
-collective past the deadline.
+collective past the deadline, ``collective.slow`` delays one rank's
+contribution SUB-deadline (``LGBM_TPU_COLLECTIVE_SLOW`` seconds) — the
+injected straggler that the fleet report must localize.
 """
 from __future__ import annotations
 
@@ -58,7 +60,8 @@ from typing import Any, Dict, List, Optional, Tuple
 import numpy as np
 
 from ..io.distributed import RankLostError, collective_deadline_s
-from ..obs import counter_add, event, span
+from ..obs import counter_add, event, gauge_set, span
+from ..obs import fleet as obs_fleet
 from ..utils.log import log_info, log_warning
 
 __all__ = [
@@ -145,10 +148,17 @@ class ElasticCoordinator:
     ``host:port`` for ``LGBM_TPU_ELASTIC``."""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
-                 heartbeat_timeout_s: Optional[float] = None):
+                 heartbeat_timeout_s: Optional[float] = None,
+                 ledger_path: Optional[str] = None):
         self.heartbeat_timeout_s = (heartbeat_timeout_s
                                     if heartbeat_timeout_s is not None
                                     else heartbeat_s() * 5)
+        # the SIGKILL-survivable fleet history (obs/fleet.FleetLedger):
+        # every membership change and completed collective round,
+        # fsync'd line-at-a-time.  Off unless a path is given
+        # (LGBM_TPU_FLEET_LEDGER or the constructor)
+        path = ledger_path or obs_fleet.ledger_path_env()
+        self._ledger = obs_fleet.FleetLedger(path) if path else None
         self._cv = threading.Condition()
         self._members: Dict[str, _Member] = {}   # member id -> _Member
         self._generation = 0
@@ -163,6 +173,13 @@ class ElasticCoordinator:
         self._rounds: Dict[Tuple[int, int], Dict[int, Any]] = {}
         self._reads: Dict[Tuple[int, int], int] = {}
         self._touch: Dict[Tuple[int, int], float] = {}
+        # per-round arrival wall-clocks {key: {rank: ts}} — ONE clock
+        # (the coordinator's), so the returned per-rank arrival list is
+        # directly comparable and each client derives its wait_s from
+        # it without any cross-rank clock agreement
+        self._arrivals: Dict[Tuple[int, int], Dict[int, float]] = {}
+        self._round_sites: Dict[Tuple[int, int], str] = {}
+        self._gauge_ranks = 0        # high-water of per-rank age gauges
         self._deadline_hint = 0.0    # max client deadline seen on the wire
         self._stop = False
         coord = self
@@ -199,6 +216,12 @@ class ElasticCoordinator:
         return f"{self.host}:{self.port}"
 
     def start(self) -> str:
+        # the coordinator is the fleet's authoritative observer: give
+        # it its own scrapeable /metrics (gated on LGBM_TPU_OPS_PORT,
+        # same as every other owner; idempotent if the launcher
+        # already mounted one)
+        from ..obs import ops_plane
+        ops_plane.mount("elastic-coordinator")
         t = threading.Thread(target=self._server.serve_forever,
                              name="lgbm-tpu-elastic-coord", daemon=True)
         t.start()
@@ -206,6 +229,8 @@ class ElasticCoordinator:
                              name="lgbm-tpu-elastic-monitor", daemon=True)
         m.start()
         self._threads = [t, m]
+        self._ledger_put("coordinator_start", address=self.address,
+                         heartbeat_timeout_s=self.heartbeat_timeout_s)
         log_info(f"elastic coordinator listening on {self.address}")
         return self.address
 
@@ -215,6 +240,13 @@ class ElasticCoordinator:
             self._cv.notify_all()
         self._server.shutdown()
         self._server.server_close()
+        self._ledger_put("coordinator_stop")
+        if self._ledger is not None:
+            self._ledger.close()
+
+    def _ledger_put(self, kind: str, **fields: Any) -> None:
+        if self._ledger is not None:
+            self._ledger.put_line(kind, **fields)
 
     # -- introspection (tests, the chaos launcher's kill scheduler) ----
     def membership(self) -> Dict[str, Any]:
@@ -249,9 +281,15 @@ class ElasticCoordinator:
                        if k[0] >= self._generation}
         self._touch = {k: v for k, v in self._touch.items()
                        if k[0] >= self._generation}
+        self._arrivals = {k: v for k, v in self._arrivals.items()
+                          if k[0] >= self._generation}
+        self._round_sites = {k: v for k, v in self._round_sites.items()
+                             if k[0] >= self._generation}
         counter_add("elastic.generation_bumps")
         event("elastic", why, generation=self._generation,
               world=len(self._members), **attrs)
+        self._ledger_put(why, generation=self._generation,
+                         world=len(self._members), **attrs)
         self._cv.notify_all()
 
     def _monitor(self) -> None:
@@ -281,7 +319,23 @@ class ElasticCoordinator:
                     self._rounds.pop(key, None)
                     self._reads.pop(key, None)
                     self._touch.pop(key, None)
+                    self._arrivals.pop(key, None)
+                    self._round_sites.pop(key, None)
                     counter_add("elastic.rounds_aged_out")
+                # ops-plane gauges: the coordinator's own state, every
+                # tick (world size, generation, open rounds, per-rank
+                # heartbeat age; ranks beyond the current world read -1
+                # so a shrink is visible, not a stale flatline)
+                ranks = self._ranks()
+                gauge_set("elastic.world_size", len(ranks))
+                gauge_set("elastic.generation", self._generation)
+                gauge_set("elastic.open_rounds", len(self._rounds))
+                for m in self._members.values():
+                    gauge_set(f"elastic.heartbeat_age_s.rank{ranks[m.member]}",
+                              round(now - m.last, 3))
+                for r in range(len(ranks), self._gauge_ranks):
+                    gauge_set(f"elastic.heartbeat_age_s.rank{r}", -1)
+                self._gauge_ranks = max(self._gauge_ranks, len(ranks))
                 for m in dead:
                     ranks = self._ranks()
                     lost_rank = ranks.get(m.member, -1)
@@ -294,7 +348,8 @@ class ElasticCoordinator:
                         f"{len(self._members)}")
                     self._bump("rank_lost", rank=lost_rank,
                                member=m.member,
-                               last_state=m.state or "unknown")
+                               last_state=m.state or "unknown",
+                               age_s=round(now - m.last, 3))
                 self._cv.wait(tick)
 
     def _dispatch(self, req: Dict[str, Any]) -> Dict[str, Any]:
@@ -311,6 +366,11 @@ class ElasticCoordinator:
             return self._op_leave(req)
         if op == "info":
             return {"ok": True, **self.membership()}
+        if op == "clock":
+            # the clock-alignment probe: no membership check (a joiner
+            # syncs before it has a rank), no state touched — just the
+            # coordinator's wall clock for midpoint-of-RTT estimation
+            return {"ok": True, "server_ts": time.time()}
         return {"ok": False, "error": f"unknown op {op!r}"}
 
     def _view(self, member: str) -> Dict[str, Any]:
@@ -385,9 +445,29 @@ class ElasticCoordinator:
                                           float(req.get("deadline_s") or 0))
             except (TypeError, ValueError):
                 pass
+            rank = ranks[member]
             parts = self._rounds.setdefault(key, {})
-            parts[ranks[member]] = req.get("payload")
+            arr = self._arrivals.setdefault(key, {})
+            if rank not in parts:
+                # coordinator-clock arrival stamp: one clock for every
+                # rank, so the returned list is directly comparable
+                arr[rank] = time.time()
+            parts[rank] = req.get("payload")
+            if req.get("site"):
+                self._round_sites[key] = str(req["site"])
             self._touch[key] = time.monotonic()
+            if len(parts) >= world:
+                # this contribution completed the round: one ledger
+                # line with the arrival spread (emitted once — by the
+                # last arriver, i.e. the straggler itself)
+                vals = sorted(arr.values())
+                self._ledger_put(
+                    "round", site=self._round_sites.get(key, ""),
+                    generation=gen, seq=seq, world=world,
+                    skew_s=round(vals[-1] - vals[0], 6) if vals else 0.0,
+                    straggler_rank=(max(arr, key=arr.get)
+                                    if arr else -1))
+                counter_add("elastic.rounds")
             self._cv.notify_all()
             while True:
                 if self._stop:
@@ -399,13 +479,18 @@ class ElasticCoordinator:
                     break
                 self._cv.wait(0.5)
             payloads = [self._rounds[key][r] for r in range(world)]
+            arrivals = [self._arrivals.get(key, {}).get(r)
+                        for r in range(world)]
             # drop the round once every member has read it
             self._reads[key] = self._reads.get(key, 0) + 1
             if self._reads[key] >= world:
                 self._rounds.pop(key, None)
                 self._reads.pop(key, None)
                 self._touch.pop(key, None)
-            return {"ok": True, "payloads": payloads}
+                self._arrivals.pop(key, None)
+                self._round_sites.pop(key, None)
+            return {"ok": True, "payloads": payloads,
+                    "arrivals": arrivals}
 
 
 # ---------------------------------------------------------------------------
@@ -449,6 +534,16 @@ class ElasticClient:
         self._hb_thread: Optional[threading.Thread] = None
         self._hb_stop = threading.Event()
         self._hb_pause = threading.Event()
+        # coordinator-clock alignment (refreshed per generation): the
+        # offset every trace record is stamped with (clk_off_s) and its
+        # rtt/2 error bound
+        self.clock_offset_s: Optional[float] = None
+        self.clock_err_s: Optional[float] = None
+        self._clock_synced_gen = -2
+        # monotonic start of the in-flight collective, if any: when a
+        # deadline fires, the recovery loop reads this to charge the
+        # whole stall to the `detect` phase of the MTTR breakdown
+        self.op_started: Optional[float] = None
 
     # -- transport -----------------------------------------------------
     def _rpc(self, msg: Dict[str, Any],
@@ -515,6 +610,7 @@ class ElasticClient:
                   min_world=int(min_world)):
             resp = retry_call(_join, what="elastic.join")
         self._adopt(resp)
+        self._maybe_sync_clock()
         event("elastic", "joined", rank=self.rank, world=self.world,
               generation=self.generation)
         self._start_heartbeat()
@@ -528,6 +624,7 @@ class ElasticClient:
             resp = self._check(self._rpc({"op": "sync",
                                           "member": self.member}))
         self._adopt(resp)
+        self._maybe_sync_clock()
         return self.world, self.rank, self.generation
 
     def _adopt(self, resp: Dict[str, Any]) -> None:
@@ -540,6 +637,36 @@ class ElasticClient:
         # whose view was already current (e.g. the heartbeat saw the
         # bump first) keyed off its peers' (generation, seq) forever
         self.seq = 0
+
+    def _maybe_sync_clock(self) -> None:
+        """Refresh the coordinator-clock offset once per adopted
+        generation (``LGBM_TPU_CLOCK_SYNC=0`` disables): midpoint-of-RTT
+        against the ``clock`` op, minimum-RTT sample, error bound
+        ``rtt/2``.  Best-effort — a sync failure leaves the previous
+        offset in place rather than interrupting training."""
+        if not obs_fleet.clock_sync_enabled():
+            return
+        if self._clock_synced_gen == self.generation:
+            return
+
+        def _fetch() -> float:
+            resp = self._rpc({"op": "clock", "member": self.member},
+                             timeout=max(self.heartbeat_interval_s * 4,
+                                         2.0))
+            if not resp.get("ok"):
+                raise RankLostError("elastic.clock", 0.0,
+                                    "clock probe refused")
+            return float(resp["server_ts"])
+
+        try:
+            off, err = obs_fleet.estimate_clock_offset(_fetch)
+        except (RankLostError, OSError, ValueError):
+            return
+        self.clock_offset_s, self.clock_err_s = off, err
+        self._clock_synced_gen = self.generation
+        obs_fleet.set_clock(off, err)
+        event("fleet", "clock_sync", offset_s=round(off, 6),
+              err_s=round(err, 6), generation=self.generation)
 
     @property
     def observed_generation(self) -> int:
@@ -562,26 +689,72 @@ class ElasticClient:
             self._hb_thread.join(timeout=2.0)
 
     # -- collectives ---------------------------------------------------
-    def allgather(self, obj: Any) -> List[Any]:
+    def allgather(self, obj: Any,
+                  site: str = "elastic.allgather") -> List[Any]:
         """Rank-ordered allgather of a JSON-serializable object within
         the current generation.  Raises :class:`GenerationChanged` when
         the membership moved, :class:`RankLostError` past the deadline
         (the ``collective.hang`` fault stalls this call to prove the
-        deadline detects it)."""
+        deadline detects it; ``collective.slow`` delays it
+        SUB-deadline — the injected straggler for skew attribution).
+
+        ``site`` names the call point; together with
+        ``(generation, seq)`` it joins per-rank trace records of the
+        same collective.  The span splits wall time into ``wait_s``
+        (blocked on later-arriving peers, from the coordinator's
+        single-clock arrival stamps) vs ``xfer_s`` (everything else:
+        transport + coordinator turnaround)."""
+        from ..obs import enabled as obs_enabled
         from ..utils.faults import fault_flag
+        if fault_flag("collective.slow"):
+            time.sleep(obs_fleet.collective_slow_s(self.deadline_s))
         self.seq += 1
         if fault_flag("collective.hang"):
             time.sleep(self.deadline_s * 1.5 + 0.05)
-        resp = self._check(self._rpc(
-            {"op": "allgather", "member": self.member,
-             "generation": self.generation, "seq": self.seq,
-             "deadline_s": self.deadline_s, "payload": obj}))
+        nbytes = -1
+        if obs_enabled():
+            try:
+                nbytes = len(json.dumps(obj).encode())
+            except (TypeError, ValueError):
+                nbytes = -1
+        # cleared on SUCCESS only: after a failure the recovery loop
+        # reads (and consumes) it as the stall start of the `detect`
+        # phase — the deadline wait is part of the MTTR, not overhead
+        # that vanishes with the exception
+        self.op_started = time.monotonic()
+        with span("collective.elastic", site=site,
+                  generation=self.generation, seq=self.seq) as sp:
+            t0 = time.perf_counter()
+            resp = self._check(self._rpc(
+                {"op": "allgather", "member": self.member,
+                 "generation": self.generation, "seq": self.seq,
+                 "deadline_s": self.deadline_s, "site": site,
+                 "payload": obj}))
+            dur = time.perf_counter() - t0
+            arrivals = resp.get("arrivals")
+            if arrivals and 0 <= self.rank < len(arrivals) \
+                    and all(a is not None for a in arrivals):
+                last = max(arrivals)
+                wait = max(last - arrivals[self.rank], 0.0)
+                straggler = arrivals.index(last)
+                sp["wait_s"] = round(wait, 6)
+                sp["xfer_s"] = round(max(dur - wait, 0.0), 6)
+                sp["arrive_ts"] = arrivals[self.rank]
+                sp["straggler_rank"] = straggler
+                if nbytes >= 0:
+                    sp["bytes"] = nbytes
+                if obs_enabled():
+                    obs_fleet.note_collective(
+                        site, self.generation, self.seq, wait,
+                        max(dur - wait, 0.0), nbytes,
+                        straggler == self.rank)
+        self.op_started = None
         return resp["payloads"]
 
-    def barrier(self, tag: str) -> None:
+    def barrier(self, tag: str, site: str = "elastic.barrier") -> None:
         """All current members reach ``tag`` (an allgather of the tag;
         mismatched tags are a protocol desync and raise loudly)."""
-        tags = self.allgather({"barrier": tag})
+        tags = self.allgather({"barrier": tag}, site=site)
         if any(t != {"barrier": tag} for t in tags):
             raise RuntimeError(f"elastic barrier desync at {tag!r}: "
                                f"{tags}")
@@ -658,14 +831,15 @@ class ElasticRun:
         return tuple(s for s in range(self.num_shards)
                      if s % self.world == self.rank)
 
-    def allgather(self, obj: Any) -> List[Any]:
+    def allgather(self, obj: Any,
+                  site: str = "elastic.allgather") -> List[Any]:
         g = self.client.observed_generation
         if g != self.generation:
             raise GenerationChanged(g, "membership moved under this run")
-        return self.client.allgather(obj)
+        return self.client.allgather(obj, site=site)
 
-    def barrier(self, tag: str) -> None:
+    def barrier(self, tag: str, site: str = "elastic.barrier") -> None:
         g = self.client.observed_generation
         if g != self.generation:
             raise GenerationChanged(g, "membership moved under this run")
-        self.client.barrier(tag)
+        self.client.barrier(tag, site=site)

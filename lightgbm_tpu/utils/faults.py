@@ -69,7 +69,14 @@ the seams where production faults actually strike:
   thread skips beats while armed; enough armed shots and the
   coordinator evicts the member (the dead-rank signal), few and the
   member survives (heartbeats are retried, not load-bearing
-  one-shots).
+  one-shots),
+* ``collective.slow`` — a SILENT fault: the elastic client sleeps
+  ``LGBM_TPU_COLLECTIVE_SLOW`` seconds (default 0.25, clamped below
+  the collective deadline) BEFORE entering the allgather — a straggler
+  without a failure, under the sub-deadline threshold where
+  ``collective.hang`` would trip rank loss; the fleet-observability
+  tests use it to prove ``tools/fleet_report.py`` names the exact slow
+  rank and site from wait/xfer accounting alone.
 
 Each point is a single ``fault_point(name)`` call that is a no-op unless
 armed.  Tests arm points programmatically (:func:`inject`, or the
@@ -97,7 +104,7 @@ POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
           "loader.read", "spmd.skip_record", "serve.score", "mem.leak",
           "det.rng_drift", "watchdog.stall", "health.nan_grad",
           "ingest.shard_fetch", "ingest.cache_write", "collective.hang",
-          "rendezvous.drop_rank", "heartbeat.miss")
+          "rendezvous.drop_rank", "heartbeat.miss", "collective.slow")
 
 
 class FaultInjected(RuntimeError):

@@ -260,6 +260,13 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     assert out["elastic_wall_s"] > 0
     assert isinstance(out["elastic_oracle_sha256"], str) \
         and len(out["elastic_oracle_sha256"]) == 64
+    # MTTR accounting (ISSUE 17): the killed run reported a positive
+    # recovery time whose phase breakdown sums to it exactly
+    assert out["elastic_mttr_s"] > 0
+    phases = out["elastic_mttr_phases"]
+    assert set(phases) == {"detect", "resync", "reshard", "restore",
+                           "retrain"}
+    assert abs(sum(phases.values()) - out["elastic_mttr_s"]) < 1e-9
     assert out["north_star_aux_detail"]["elastic"] in (
         "measured", "pending-capture"), out["north_star_aux_detail"]
     # device-time attribution gate (ISSUE 10): the REAL leg ran at toy

@@ -1,0 +1,426 @@
+"""Fleet observability suite (obs/fleet.py + tools/fleet_report.py +
+the elastic wiring).
+
+ISSUE 17 acceptance, all on CPU in tier-1:
+
+* clock alignment — midpoint-of-RTT offset with the ``rtt/2`` error
+  bound; telemetry stamps ``clk_off_s`` into trace records,
+* straggler attribution — a REAL 2-process elastic run with an
+  injected ``collective.slow`` straggler: ``tools/fleet_report.py``
+  merges the per-rank traces + coordinator ledger and names the EXACT
+  slow rank and site, with an offset-corrected timeline that stays
+  monotone within every collective,
+* coordinator ops plane — ``/metrics`` scrapes valid Prometheus
+  (world size / generation / heartbeat-age gauges) during the live
+  run,
+* the fleet ledger — survives a coordinator SIGKILL with every line
+  parseable (strict ``read_ledger``),
+* recovery MTTR — ``RecoveryEpisode`` phase durations sum EXACTLY to
+  ``mttr_s`` (the chaos-harness side is asserted in
+  ``tests/test_elastic.py``).
+"""
+import json
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+import urllib.request
+
+import pytest
+
+from lightgbm_tpu import obs
+from lightgbm_tpu.obs import fleet, ops_plane
+from lightgbm_tpu.obs import health
+from tools.fleet_report import build_report, chrome_trace, corrected_ts
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    obs.reset()
+    yield
+    ops_plane.shutdown()
+    health._set_active(False)
+    obs.reset()
+
+
+# ---------------------------------------------------------------------------
+# clock alignment
+# ---------------------------------------------------------------------------
+def test_estimate_clock_offset_midpoint_and_error_bound():
+    """A server clock 3.5s ahead behind a symmetric 20ms RTT: the
+    midpoint estimate recovers the offset within rtt/2."""
+    skew = 3.5
+    delay = 0.01
+
+    def fetch():
+        time.sleep(delay)           # request leg
+        ts = time.time() + skew
+        time.sleep(delay)           # response leg
+        return ts
+
+    off, err = fleet.estimate_clock_offset(fetch, samples=3)
+    assert err >= delay             # bound >= one-way delay
+    assert abs(off - skew) <= err + 0.05
+
+
+def test_set_clock_stamps_clk_off_into_trace_records(tmp_path):
+    trace = str(tmp_path / "t.jsonl")
+    obs.enable(trace)
+    fleet.set_clock(1.25, 0.002)
+    with obs.span("unit.work"):
+        pass
+    obs.disable()
+    recs = [json.loads(l) for l in open(trace)]
+    spans = [r for r in recs if r.get("kind") == "span"]
+    assert spans and all(r["clk_off_s"] == 1.25 for r in spans)
+    # and the summary carries the installed clock
+    s = obs.summary()
+    assert s["clock"]["offset_s"] == 1.25
+    assert s["clock"]["err_s"] == 0.002
+
+
+# ---------------------------------------------------------------------------
+# recovery MTTR accounting
+# ---------------------------------------------------------------------------
+def test_recovery_episode_phases_sum_exactly_to_mttr():
+    ep = fleet.RecoveryEpisode(error="RankLostError", generation=4,
+                               target_iter=7,
+                               stall_started=time.monotonic() - 0.2)
+    ep.mark("detect")
+    time.sleep(0.01)
+    ep.mark("resync")
+    ep.mark("reshard")
+    time.sleep(0.01)
+    ep.mark("restore")
+    rec = ep.finish(iteration=7)
+    assert rec["error"] == "RankLostError"
+    assert rec["target_iter"] == 7
+    assert set(rec["phases"]) == set(fleet.RECOVERY_PHASES)
+    # the exact-sum contract: mttr_s is DEFINED as the phase sum
+    assert rec["mttr_s"] == sum(rec["phases"].values())
+    assert rec["phases"]["detect"] >= 0.2       # the stall wait
+    assert fleet.recovery_episodes() == [rec]
+    # double finish is a no-op; abandon keeps the ledger clean
+    assert ep.finish() is None
+    ep2 = fleet.RecoveryEpisode()
+    ep2.abandon()
+    assert ep2.finish() is None
+    assert len(fleet.recovery_episodes()) == 1
+
+
+# ---------------------------------------------------------------------------
+# the fleet ledger
+# ---------------------------------------------------------------------------
+def test_ledger_append_read_roundtrip(tmp_path):
+    path = str(tmp_path / "led.jsonl")
+    led = fleet.FleetLedger(path)
+    led.put_line("join", member="a", rank=0)
+    led.put_line("round", site="elastic.wave_hist", seq=3)
+    led.close()
+    led.put_line("after_close")       # swallowed, not an error
+    out = fleet.read_ledger(path)
+    assert [e["kind"] for e in out] == ["join", "round"]
+    assert out[1]["site"] == "elastic.wave_hist"
+    assert all("ts" in e for e in out)
+
+
+def test_read_ledger_strict_on_torn_line(tmp_path):
+    path = str(tmp_path / "torn.jsonl")
+    with open(path, "w") as f:
+        f.write('{"ts": 1.0, "kind": "ok"}\n{"ts": 2.0, "ki')
+    with pytest.raises(ValueError, match=r"torn\.jsonl:2"):
+        fleet.read_ledger(path)
+
+
+def test_ledger_survives_sigkill_every_line_parseable(tmp_path):
+    """The durability contract: SIGKILL a process mid-append-loop;
+    every line already on disk parses (no tmp files, no torn tail)."""
+    path = str(tmp_path / "killed.jsonl")
+    code = (
+        "import sys\n"
+        "from lightgbm_tpu.obs.fleet import FleetLedger\n"
+        "led = FleetLedger(sys.argv[1])\n"
+        "i = 0\n"
+        "while True:\n"
+        "    led.put_line('tick', i=i, pad='x' * 96)\n"
+        "    i += 1\n")
+    env = dict(os.environ, PYTHONPATH=REPO
+               + os.pathsep + os.environ.get("PYTHONPATH", ""))
+    proc = subprocess.Popen([sys.executable, "-c", code, path],
+                            cwd=REPO, env=env)
+    try:
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            if os.path.exists(path) and os.path.getsize(path) > 4096:
+                break
+            time.sleep(0.01)
+        else:
+            pytest.fail("ledger writer produced no output")
+        os.kill(proc.pid, signal.SIGKILL)
+        proc.wait(10)
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+    out = fleet.read_ledger(path)       # strict: raises on a torn line
+    assert len(out) >= 10
+    assert all(e["kind"] == "tick" for e in out)
+    assert [e["i"] for e in out] == list(range(len(out)))
+
+
+# ---------------------------------------------------------------------------
+# skew accounting + merge
+# ---------------------------------------------------------------------------
+def test_note_collective_and_merge_skew_names_dominant_straggler():
+    for _ in range(4):
+        fleet.note_collective("elastic.wave_hist", 2, 1, wait_s=0.2,
+                              xfer_s=0.01, nbytes=100, straggler=False)
+    snap0 = fleet.skew_snapshot()
+    assert snap0["elastic.wave_hist"]["waves"] == 4
+    assert snap0["elastic.wave_hist"]["wait_total_s"] == pytest.approx(0.8)
+    # rank 1's view: it waited ~0 and was the straggler every wave
+    snap1 = {"elastic.wave_hist": {
+        "waves": 4, "wait_total_s": 0.0, "wait_max_s": 0.0,
+        "xfer_total_s": 0.04, "bytes_total": 400, "straggler_waves": 4}}
+    merged = fleet.merge_skew([{"collective_skew": snap0},
+                               {"collective_skew": snap1}])
+    st = merged["elastic.wave_hist"]
+    assert st["straggler_rank"] == 1
+    assert st["straggler_pct"] == 100.0
+    assert st["per_rank_wait_s"][0] == pytest.approx(0.8)
+    assert st["per_rank_wait_s"][1] == 0.0
+    assert fleet.merge_skew([{}, {}]) is None
+
+
+def test_collective_slow_clamps_below_deadline(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_COLLECTIVE_SLOW", raising=False)
+    assert fleet.collective_slow_s() == 0.25
+    assert fleet.collective_slow_s(deadline_s=0.1) == pytest.approx(0.05)
+    monkeypatch.setenv("LGBM_TPU_COLLECTIVE_SLOW", "2.0")
+    assert fleet.collective_slow_s(deadline_s=10.0) == 2.0
+    assert fleet.collective_slow_s(deadline_s=1.0) == pytest.approx(0.5)
+    monkeypatch.setenv("LGBM_TPU_COLLECTIVE_SLOW", "junk")
+    assert fleet.collective_slow_s() == 0.25
+
+
+# ---------------------------------------------------------------------------
+# fleet_report units (synthetic traces)
+# ---------------------------------------------------------------------------
+def _span(rank, site, seq, ts, dur, wait, arrive, straggler,
+          clk_off=None, gen=2):
+    rec = {"kind": "span", "name": "collective.elastic", "rank": rank,
+           "site": site, "generation": gen, "seq": seq, "ts": ts,
+           "dur_s": dur, "wait_s": wait,
+           "xfer_s": max(dur - wait, 0.0), "arrive_ts": arrive,
+           "straggler_rank": straggler}
+    if clk_off is not None:
+        rec["clk_off_s"] = clk_off
+    return rec
+
+
+def test_build_report_joins_ranks_and_checks_monotone():
+    # rank 0 is 5s behind the coordinator (clk_off +5); rank 1 aligned.
+    # Both arrive stamps are coordinator-clock (elastic site).
+    recs = [
+        _span(0, "elastic.x", 1, ts=100.0, dur=1.0, wait=0.5,
+              arrive=105.2, straggler=1, clk_off=5.0),
+        _span(1, "elastic.x", 1, ts=104.9, dur=0.8, wait=0.0,
+              arrive=105.7, straggler=1),
+        {"kind": "event", "family": "elastic", "name": "recovery",
+         "rank": 0, "ts": 110.0, "mttr_s": 1.5, "detect_s": 1.0,
+         "resync_s": 0.2, "reshard_s": 0.1, "restore_s": 0.1,
+         "retrain_s": 0.1, "error": "RankLostError", "generation": 3,
+         "target_iter": 4},
+    ]
+    rep = build_report(recs, eps=0.25)
+    assert rep["monotone"]["ok"], rep["monotone"]
+    assert rep["monotone"]["checked"] == 1
+    st = rep["skew"]["elastic.x"]
+    assert st["straggler_rank"] == 1 and st["waves"] == 1
+    assert st["skew_p50_s"] == pytest.approx(0.5)
+    assert rep["clock_offsets_s"] == {"0": 5.0}
+    ep = rep["recovery"]["episodes"][0]
+    assert ep["phases_sum_ok"] and ep["mttr_s"] == 1.5
+    assert rep["recovery"]["ok"]
+    # corrected_ts maps rank 0 onto the coordinator clock
+    assert corrected_ts(recs[0]) == pytest.approx(105.0)
+
+
+def test_build_report_flags_wrong_offsets():
+    """A bad offset makes rank 0's span END before the arrival it
+    waited for — the monotone audit names the violation."""
+    recs = [
+        _span(0, "elastic.x", 1, ts=100.0, dur=1.0, wait=0.5,
+              arrive=105.2, straggler=1, clk_off=2.0),   # should be ~5
+        _span(1, "elastic.x", 1, ts=104.9, dur=0.8, wait=0.0,
+              arrive=105.7, straggler=1),
+    ]
+    rep = build_report(recs, eps=0.25)
+    assert not rep["monotone"]["ok"]
+    v = rep["monotone"]["violations"][0]
+    assert v["rank"] == 0 and v["site"] == "elastic.x"
+
+
+def test_chrome_trace_tracks_per_rank_plus_coordinator():
+    recs = [_span(r, "elastic.x", 1, ts=100.0 + r, dur=0.5, wait=0.0,
+                  arrive=100.5, straggler=1) for r in (0, 1)]
+    ledger = [{"ts": 99.0, "kind": "coordinator_start"},
+              {"ts": 100.0, "kind": "join", "member": "a"}]
+    ct = chrome_trace(recs, ledger)
+    evs = ct["traceEvents"]
+    pids = {e["pid"] for e in evs if e["ph"] == "X"}
+    assert pids == {0, 1}
+    names = {e["args"]["name"] for e in evs if e["ph"] == "M"}
+    assert names == {"rank 0", "rank 1", "coordinator"}
+    assert sum(1 for e in evs if e["ph"] == "i") == 2
+
+
+# ---------------------------------------------------------------------------
+# the acceptance run: REAL 2-process straggler localization
+# ---------------------------------------------------------------------------
+_PROM_LINE = re.compile(
+    r"^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z0-9_]+=\"[^\"]*\""
+    r"(,[a-zA-Z0-9_]+=\"[^\"]*\")*\})? -?[0-9.eE+naif]+$")
+
+
+def _spawn_worker(rundir, spec_path, address, member, trace, extra):
+    env = dict(os.environ)
+    env.pop("LGBM_TPU_OPS_PORT", None)      # the plane under test is
+    env.pop("LGBM_TPU_FLEET_LEDGER", None)  # the coordinator's
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "LGBM_TPU_ELASTIC": address,
+        "LGBM_TPU_ELASTIC_MEMBER": member,
+        "LGBM_TPU_HEARTBEAT_S": "0.1",
+        "LGBM_TPU_TRACE": trace,
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.update(extra)
+    log = open(os.path.join(rundir, f"log-{member}.txt"), "w")
+    return subprocess.Popen(
+        [sys.executable, "-m", "tools.chaos", "--worker", spec_path],
+        cwd=REPO, env=env, stdout=log, stderr=subprocess.STDOUT)
+
+
+def test_two_process_straggler_localized_by_fleet_report(
+        tmp_path, monkeypatch):
+    """The ISSUE 17 acceptance core: a real 2-process elastic train
+    with rank 1 armed ``collective.slow`` — the merged fleet report
+    names rank 1 at the training collective sites, the offset-corrected
+    timeline stays monotone, the coordinator's /metrics scrapes valid
+    Prometheus mid-run, the ledger strict-parses, and rank 0 wrote the
+    merged ``.summary.json`` over the elastic allgather."""
+    from tools.chaos import default_spec
+    from lightgbm_tpu.parallel.elastic import ElasticCoordinator
+
+    rundir = str(tmp_path)
+    ledger_path = os.path.join(rundir, "fleet.jsonl")
+    monkeypatch.setenv("LGBM_TPU_OPS_PORT", "0")
+    spec = default_spec(rundir, workers=2, iters=4, rows=256,
+                        features=6)
+    spec["min_world"] = 2
+    spec_path = os.path.join(rundir, "spec.json")
+    with open(spec_path, "w") as f:
+        json.dump(spec, f)
+
+    coord = ElasticCoordinator(heartbeat_timeout_s=5.0,
+                               ledger_path=ledger_path)
+    address = coord.start()
+    plane = ops_plane.plane()
+    assert plane is not None        # the coordinator mounted it
+    traces = [os.path.join(rundir, f"trace-{r}.jsonl") for r in (0, 1)]
+    procs = []
+    scraped = []
+    try:
+        procs.append(_spawn_worker(rundir, spec_path, address,
+                                   "worker-0", traces[0], {}))
+        # worker-0 must register first: ranks follow join order, so
+        # the straggler is DETERMINISTICALLY rank 1
+        deadline = time.monotonic() + 60
+        while coord.membership()["world"] < 1 \
+                and time.monotonic() < deadline:
+            time.sleep(0.02)
+        assert coord.membership()["world"] == 1
+        procs.append(_spawn_worker(
+            rundir, spec_path, address, "worker-1", traces[1],
+            {"LGBM_TPU_FAULTS": "collective.slow:9999",
+             "LGBM_TPU_COLLECTIVE_SLOW": "0.15"}))
+        # scrape /metrics WHILE the fleet trains
+        deadline = time.monotonic() + 120
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{plane.port}/metrics",
+                    timeout=10) as resp:
+                body = resp.read().decode()
+            scraped.append(body)
+            if "lgbm_tpu_elastic_world_size 2" in body \
+                    and "lgbm_tpu_elastic_heartbeat_age_s_rank1" in body:
+                break
+            if all(p.poll() is not None for p in procs):
+                break
+            time.sleep(0.1)
+        for p in procs:
+            assert p.wait(180) == 0, \
+                open(os.path.join(rundir, "log-worker-1.txt")).read()
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        coord.stop()
+        ops_plane.shutdown()
+
+    # -- live metrics: valid Prometheus with the coordinator gauges ----
+    live = scraped[-1]
+    for ln in live.splitlines():
+        if ln.strip() and not ln.startswith("#"):
+            assert _PROM_LINE.match(ln), ln
+    assert "lgbm_tpu_elastic_world_size 2" in live
+    assert "lgbm_tpu_elastic_generation 2" in live
+    assert "lgbm_tpu_elastic_heartbeat_age_s_rank0" in live
+
+    # -- the ledger: strict parse, the expected history -----------------
+    ledger = fleet.read_ledger(ledger_path)
+    kinds = {e["kind"] for e in ledger}
+    assert {"coordinator_start", "join", "round"} <= kinds
+    rounds = [e for e in ledger if e["kind"] == "round"]
+    assert rounds and all("skew_s" in e and "straggler_rank" in e
+                          for e in rounds)
+    # the coordinator saw the same straggler the ranks did
+    slow = [e for e in rounds if e["straggler_rank"] == 1]
+    assert len(slow) >= 0.9 * len(rounds)
+
+    # -- the merged report: EXACT rank + site localization --------------
+    from tools.fleet_report import load_traces
+    records = load_traces(traces)
+    rep = build_report(records, ledger=ledger, eps=0.25)
+    assert rep["ranks"] == [0, 1]
+    assert rep["monotone"]["ok"], rep["monotone"]["violations"]
+    assert rep["monotone"]["checked"] >= 5
+    site = rep["skew"]["elastic.wave_hist"]     # the hot training site
+    assert site["straggler_rank"] == 1
+    assert site["straggler_pct"] >= 90.0
+    assert site["skew_p50_s"] >= 0.1            # the injected 0.15s
+    assert rep["recovery"]["ok"]                # no failures: no episodes
+    assert rep["recovery"]["episodes"] == []
+    # both ranks synced their clock against the coordinator
+    assert set(rep["clock_offsets_s"]) == {"0", "1"}
+
+    # -- rank 0 merged the fleet summary over the ELASTIC allgather -----
+    summary = json.load(open(traces[0] + ".summary.json"))
+    sk = summary["collective_skew"]["elastic.wave_hist"]
+    assert sk["straggler_rank"] == 1 and sk["straggler_pct"] >= 90.0
+    assert summary["process_count"] == 2
+    assert not os.path.exists(traces[1] + ".summary.json")
+
+    # -- the CLI round-trip: chrome export + exit 0 ---------------------
+    from tools.fleet_report import main as fleet_main
+    chrome = os.path.join(rundir, "chrome.json")
+    rc = fleet_main(traces + ["--ledger", ledger_path,
+                              "--chrome", chrome, "--json"])
+    assert rc == 0
+    ct = json.load(open(chrome))
+    pids = {e["pid"] for e in ct["traceEvents"] if e["ph"] == "X"}
+    assert pids == {0, 1}

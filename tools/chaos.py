@@ -126,6 +126,11 @@ def worker_main(spec_path: str) -> int:
                             min_world=int(spec.get("min_world", 1)))
     member = os.environ.get("LGBM_TPU_ELASTIC_MEMBER", f"pid{os.getpid()}")
     result = dict(_model_identity(booster), member=member)
+    # MTTR accounting (ISSUE 17): episodes are recorded module-side
+    # whether or not tracing is on, so every survivor reports how long
+    # each recovery it lived through took, phase by phase
+    from lightgbm_tpu.obs import fleet
+    result["episodes"] = fleet.recovery_episodes()
     out = os.path.join(os.path.dirname(spec_path), f"result-{member}.json")
     with open(out + ".tmp", "w") as f:
         json.dump(result, f)
@@ -264,6 +269,29 @@ def run_chaos(workers: int = 2, shards: int = 0, iters: int = 8,
                 verdict["errors"].append(
                     f"{res['member']} {key} mismatch: {res[key][:12]} != "
                     f"oracle {want[key][:12]}")
+
+    # MTTR verdict (ISSUE 17): a killed run must leave at least one
+    # survivor-recorded recovery episode whose phases sum to mttr_s;
+    # the slowest episode becomes THE headline number for the run
+    episodes = [dict(ep, member=res["member"])
+                for res in verdict["results"]
+                for ep in res.get("episodes", [])]
+    for ep in episodes:
+        gap = abs(sum(ep["phases"].values()) - ep["mttr_s"])
+        if gap > 1e-9:
+            verdict["errors"].append(
+                f"{ep['member']} episode phases sum "
+                f"{sum(ep['phases'].values()):.6f}s != mttr "
+                f"{ep['mttr_s']:.6f}s")
+    if verdict["killed"] is not None and verdict["results"] \
+            and not episodes:
+        verdict["errors"].append(
+            "rank was killed but no survivor recorded a recovery "
+            "episode")
+    if episodes:
+        top = max(episodes, key=lambda ep: ep["mttr_s"])
+        verdict["recovery"] = top
+        verdict["mttr_s"] = top["mttr_s"]
     verdict["ok"] = not verdict["errors"]
     return verdict
 
@@ -307,9 +335,11 @@ def main(argv: Optional[List[str]] = None) -> int:
     else:
         for err in verdict["errors"]:
             print(f"[chaos] FAIL: {err}")
+        mttr = verdict.get("mttr_s")
+        mttr_txt = f", mttr={mttr:.3f}s" if mttr is not None else ""
         print(f"[chaos] {'OK' if verdict['ok'] else 'FAILED'}: "
               f"{len(verdict['results'])} result(s), killed="
-              f"{verdict['killed']}, oracle "
+              f"{verdict['killed']}{mttr_txt}, oracle "
               f"{verdict['oracle']['model_sha256'][:12]}")
     return 0 if verdict["ok"] else 1
 

@@ -235,6 +235,16 @@ EXEMPT_ENV: Dict[str, str] = {
                                "member id for rejoin/chaos kill "
                                "scheduling); naming only, the rank map "
                                "is the coordinator's",
+    "LGBM_TPU_FLEET_LEDGER": "observability: coordinator ops-ledger "
+                             "JSONL destination (obs/fleet.py); "
+                             "append-only history of the fleet, never "
+                             "read back into training",
+    "LGBM_TPU_CLOCK_SYNC": "observability: per-rank coordinator-clock "
+                           "offset estimation; stamps trace records "
+                           "only, model state untouched",
+    "LGBM_TPU_COLLECTIVE_SLOW": "fault-injection straggler delay "
+                                "(collective.slow); a sleep before the "
+                                "collective, identity-neutral",
 }
 
 # -- DET004: first-max tie-break contracts -------------------------------

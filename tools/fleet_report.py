@@ -1,0 +1,322 @@
+#!/usr/bin/env python
+"""Merge per-rank telemetry traces + the coordinator ledger into one
+fleet view (ISSUE 17): causally-ordered timeline, per-site straggler
+attribution, recovery MTTR breakdown, chrome-trace export.
+
+Usage::
+
+    python tools/fleet_report.py trace.jsonl.rank0 trace.jsonl.rank1 \\
+        [--ledger fleet.jsonl] [--chrome out.json] [--json] [--eps 0.25]
+
+Inputs are the JSONL traces written via ``LGBM_TPU_TRACE`` (one
+``.rank<k>`` file per rank) and, optionally, the coordinator's fleet
+ledger (``LGBM_TPU_FLEET_LEDGER``).  What the merge relies on:
+
+* every record may carry ``clk_off_s`` — the rank's coordinator-clock
+  offset (midpoint-of-RTT, ``obs/fleet.py``); corrected time is
+  ``ts + clk_off_s``, putting all ranks AND the ledger on one clock;
+* host-collective spans carry the join key ``(site, generation, seq)``
+  plus ``wait_s`` / ``xfer_s`` / ``arrive_ts`` / ``straggler_rank``,
+  so per-rank records of the same collective join exactly.
+
+Sections of the report:
+
+* ``skew`` — per site: waves, p50/p99 arrival skew (the max wait of a
+  wave), and the straggler histogram ("rank 2 last into hist_psum 87%
+  of waves").  Needs no clock agreement at all: each wave's straggler
+  is named consistently on every rank by the collective itself.
+* ``monotone`` — the offset-correction audit: within every joined
+  collective, each rank's corrected span must OVERLAP the wave's
+  arrival window (a collective span cannot end before the last rank
+  arrived).  Violations beyond ``--eps`` (clock error bound + pipe
+  slack) mean the offsets are wrong, not the fleet.
+* ``recovery`` — every ``elastic:recovery`` event: per-phase
+  ``detect/resync/reshard/restore/retrain`` durations and the check
+  that they sum to ``mttr_s`` (they do by construction; the report
+  re-verifies from the records).
+* ``ledger`` — the coordinator's own history (joins, evictions,
+  generation bumps, completed rounds), merged into the timeline as
+  its own track.
+
+``--chrome`` writes a Chrome-trace JSON loadable in Perfetto /
+``chrome://tracing``: one track (pid) per rank plus a coordinator
+track, span records as complete ("X") events on the corrected clock.
+"""
+import argparse
+import json
+import sys
+from collections import defaultdict
+
+
+def load_traces(paths):
+    records = []
+    for path in paths:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                rec["_src"] = path
+                records.append(rec)
+    return records
+
+
+def load_ledger(path):
+    try:
+        from lightgbm_tpu.obs.fleet import read_ledger
+    except ImportError:
+        sys.path.insert(0, ".")
+        from lightgbm_tpu.obs.fleet import read_ledger
+    return read_ledger(path)
+
+
+def corrected_ts(rec):
+    """ts on the coordinator clock: ts + clk_off_s (0 when unstamped —
+    a rank that never synced is assumed already aligned)."""
+    return float(rec.get("ts", 0.0)) + float(rec.get("clk_off_s", 0.0))
+
+
+def corrected_arrive(rec):
+    """The record's arrival stamp on the coordinator clock.  Elastic
+    collectives stamp ``arrive_ts`` FROM the coordinator's clock
+    (no correction); io.distributed collectives stamp it from the
+    local clock (corrected like ``ts``)."""
+    a = rec.get("arrive_ts")
+    if a is None:
+        return None
+    if str(rec.get("site", "")).startswith("elastic."):
+        return float(a)
+    return float(a) + float(rec.get("clk_off_s", 0.0))
+
+
+def _is_collective(rec):
+    return (rec.get("kind") == "span" and "site" in rec
+            and "seq" in rec and "wait_s" in rec)
+
+
+def _pct(values, q):
+    if not values:
+        return 0.0
+    vals = sorted(values)
+    idx = min(int(round(q * (len(vals) - 1))), len(vals) - 1)
+    return vals[idx]
+
+
+def build_report(records, ledger=None, eps=0.25):
+    # -- join collectives on (site, generation, seq) -------------------
+    groups = defaultdict(list)
+    for r in records:
+        if _is_collective(r):
+            key = (r["site"], int(r.get("generation", -1)),
+                   int(r["seq"]))
+            groups[key].append(r)
+
+    per_site = defaultdict(lambda: {"waves": 0, "skew_s": [],
+                                    "stragglers": defaultdict(int)})
+    violations = []
+    checked = 0
+    for (site, gen, seq), recs in sorted(groups.items()):
+        st = per_site[site]
+        st["waves"] += 1
+        # wave skew = the max wait anyone spent blocked on peers; the
+        # straggler is named identically on every rank's record (it
+        # came from the shared arrival list), so take any
+        st["skew_s"].append(max(float(r.get("wait_s", 0.0))
+                                for r in recs))
+        strag = recs[0].get("straggler_rank")
+        if strag is None:
+            strag = min(recs, key=lambda r: float(r.get("wait_s", 0.0))
+                        ).get("rank", -1)
+        st["stragglers"][int(strag)] += 1
+        # monotonicity audit: every rank's corrected span must overlap
+        # the wave's arrival window (no record may END before the last
+        # arrival it claims to have waited for)
+        arrivals = [corrected_arrive(r) for r in recs]
+        arrivals = [a for a in arrivals if a is not None]
+        if len(arrivals) >= 2:
+            checked += 1
+            last_arrive = max(arrivals)
+            for r in recs:
+                end = corrected_ts(r) + float(r.get("dur_s", 0.0))
+                a = corrected_arrive(r)
+                start = corrected_ts(r)
+                bad = (end + eps < last_arrive
+                       or (a is not None
+                           and not (start - eps <= a <= end + eps)))
+                if bad:
+                    violations.append({
+                        "site": site, "generation": gen, "seq": seq,
+                        "rank": r.get("rank", -1),
+                        "start": start, "end": end, "arrive": a,
+                        "last_arrive": last_arrive,
+                    })
+
+    skew = {}
+    for site, st in per_site.items():
+        hist = dict(sorted(st["stragglers"].items()))
+        total = sum(hist.values()) or 1
+        top = max(hist, key=lambda r: hist[r]) if hist else -1
+        skew[site] = {
+            "waves": st["waves"],
+            "skew_p50_s": round(_pct(st["skew_s"], 0.50), 6),
+            "skew_p99_s": round(_pct(st["skew_s"], 0.99), 6),
+            "straggler_hist": {str(r): c for r, c in hist.items()},
+            "straggler_rank": int(top),
+            "straggler_pct": round(100.0 * hist.get(top, 0) / total, 1),
+        }
+
+    # -- recovery episodes (elastic:recovery events) -------------------
+    episodes = []
+    phase_keys = ("detect_s", "resync_s", "reshard_s", "restore_s",
+                  "retrain_s")
+    for r in records:
+        if r.get("kind") == "event" and r.get("family") == "elastic" \
+                and r.get("name") == "recovery":
+            phases = {k: float(r.get(k, 0.0)) for k in phase_keys}
+            mttr = float(r.get("mttr_s", 0.0))
+            episodes.append({
+                "rank": r.get("rank", -1),
+                "error": r.get("error", ""),
+                "generation": r.get("generation", -1),
+                "target_iter": r.get("target_iter", 0),
+                "mttr_s": mttr,
+                "phases": phases,
+                "phases_sum_ok": abs(sum(phases.values()) - mttr) < 1e-6,
+            })
+
+    # -- clock offsets (what the correction used) ----------------------
+    clocks = {}
+    for r in records:
+        if "clk_off_s" in r:
+            clocks[str(r.get("rank", -1))] = float(r["clk_off_s"])
+
+    report = {
+        "ranks": sorted({r.get("rank", 0) for r in records}),
+        "records": len(records),
+        "collectives": {"sites": len(skew),
+                        "waves": sum(s["waves"] for s in skew.values()),
+                        "joined": len(groups)},
+        "clock_offsets_s": clocks,
+        "skew": skew,
+        "monotone": {"ok": not violations, "checked": checked,
+                     "eps_s": eps, "violations": violations[:20]},
+        "recovery": {"episodes": episodes,
+                     "ok": all(e["phases_sum_ok"] for e in episodes)},
+    }
+    if ledger is not None:
+        kinds = defaultdict(int)
+        for e in ledger:
+            kinds[e.get("kind", "?")] += 1
+        report["ledger"] = {"events": len(ledger), "kinds": dict(kinds)}
+    return report
+
+
+def chrome_trace(records, ledger=None):
+    """Chrome-trace JSON (Perfetto-loadable): one pid per rank, span
+    records as complete events on the corrected (coordinator) clock,
+    ledger entries as instant events on a coordinator track."""
+    events = []
+    for r in records:
+        if r.get("kind") != "span":
+            continue
+        rank = int(r.get("rank", 0))
+        events.append({
+            "name": r.get("name", "?"),
+            "cat": r.get("site", "span"),
+            "ph": "X",
+            "ts": corrected_ts(r) * 1e6,
+            "dur": float(r.get("dur_s", 0.0)) * 1e6,
+            "pid": rank, "tid": int(r.get("depth", 0)),
+            "args": {k: v for k, v in r.items()
+                     if k not in ("kind", "name", "ts", "dur_s")},
+        })
+    meta = [{"name": "process_name", "ph": "M", "pid": p,
+             "args": {"name": f"rank {p}"}}
+            for p in sorted({e["pid"] for e in events})]
+    if ledger:
+        COORD_PID = 10_000
+        meta.append({"name": "process_name", "ph": "M",
+                     "pid": COORD_PID, "args": {"name": "coordinator"}})
+        for e in ledger:
+            events.append({
+                "name": e.get("kind", "?"), "cat": "ledger", "ph": "i",
+                "ts": float(e.get("ts", 0.0)) * 1e6, "s": "g",
+                "pid": COORD_PID, "tid": 0,
+                "args": {k: v for k, v in e.items()
+                         if k not in ("kind", "ts")},
+            })
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
+
+
+def render(report, out=sys.stdout):
+    print(f"ranks: {report['ranks']}    records: {report['records']}",
+          file=out)
+    co = report["collectives"]
+    print(f"collectives: {co['waves']} waves over {co['sites']} sites "
+          f"({co['joined']} joined keys)", file=out)
+    if report["clock_offsets_s"]:
+        offs = ", ".join(f"r{r}={o:+.4f}s" for r, o in
+                         sorted(report["clock_offsets_s"].items()))
+        print(f"clock offsets: {offs}", file=out)
+    if report["skew"]:
+        print("\n== straggler attribution ==", file=out)
+        for site in sorted(report["skew"]):
+            s = report["skew"][site]
+            print(f"  {site:<34s} waves={s['waves']:<5d} "
+                  f"skew p50={s['skew_p50_s']:.3f}s "
+                  f"p99={s['skew_p99_s']:.3f}s   straggler: rank "
+                  f"{s['straggler_rank']} ({s['straggler_pct']:.0f}% "
+                  f"of waves)", file=out)
+    mono = report["monotone"]
+    state = "OK" if mono["ok"] else \
+        f"{len(mono['violations'])} violation(s)"
+    print(f"\ntimeline monotone per collective: {state} "
+          f"({mono['checked']} checked, eps={mono['eps_s']}s)", file=out)
+    eps = report["recovery"]["episodes"]
+    if eps:
+        print("\n== recovery episodes ==", file=out)
+        for e in eps:
+            ph = "  ".join(f"{k[:-2]}={v:.3f}s"
+                           for k, v in e["phases"].items())
+            ok = "" if e["phases_sum_ok"] else "  [SUM MISMATCH]"
+            print(f"  rank {e['rank']} {e['error']:<18s} "
+                  f"mttr={e['mttr_s']:.3f}s  {ph}{ok}", file=out)
+    if "ledger" in report:
+        led = report["ledger"]
+        kinds = ", ".join(f"{k}={v}" for k, v in
+                          sorted(led["kinds"].items()))
+        print(f"\nledger: {led['events']} event(s): {kinds}", file=out)
+
+
+def main(argv):
+    ap = argparse.ArgumentParser(
+        description="merge per-rank traces + coordinator ledger into "
+                    "one fleet report")
+    ap.add_argument("traces", nargs="+", help="per-rank JSONL traces")
+    ap.add_argument("--ledger", help="coordinator fleet ledger (JSONL)")
+    ap.add_argument("--chrome", help="write chrome-trace JSON here")
+    ap.add_argument("--json", action="store_true",
+                    help="print the report as JSON instead of text")
+    ap.add_argument("--eps", type=float, default=0.25,
+                    help="monotonicity slack (clock error bound), "
+                         "seconds")
+    args = ap.parse_args(argv)
+    records = load_traces(args.traces)
+    ledger = load_ledger(args.ledger) if args.ledger else None
+    report = build_report(records, ledger=ledger, eps=args.eps)
+    if args.chrome:
+        with open(args.chrome, "w") as f:
+            json.dump(chrome_trace(records, ledger), f)
+        print(f"chrome trace written: {args.chrome}", file=sys.stderr)
+    if args.json:
+        json.dump(report, sys.stdout, indent=1)
+        print()
+    else:
+        render(report)
+    return 0 if (report["monotone"]["ok"]
+                 and report["recovery"]["ok"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main(sys.argv[1:]))

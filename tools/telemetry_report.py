@@ -135,6 +135,34 @@ def _try_summary(path):
         return None
 
 
+def collective_skew_block(sk, out=sys.stdout):
+    """The "collective skew" section (ISSUE 17): per-site arrival-wait
+    accounting.  Renders both shapes — a single-rank summary carries
+    this rank's wait/xfer totals; a merged summary carries the
+    side-by-side per-rank table with the dominant straggler."""
+    if not sk:
+        return
+    print("\n== collective skew ==", file=out)
+    for site in sorted(sk):
+        st = sk[site]
+        if "per_rank_wait_s" in st:     # merged (fleet) shape
+            waits = ", ".join(f"r{r}={w:.3f}s" for r, w in
+                              enumerate(st.get("per_rank_wait_s", [])))
+            line = (f"  {site:<34s} waves={st.get('waves', 0):<5d} "
+                    f"wait[{waits}] max={st.get('wait_max_s', 0.0):.3f}s")
+            if "straggler_rank" in st:
+                line += (f"  straggler: rank {st['straggler_rank']} "
+                         f"({st.get('straggler_pct', 0.0):.0f}% of waves)")
+            print(line, file=out)
+        else:                           # single-rank shape
+            print(f"  {site:<34s} waves={st.get('waves', 0):<5d} "
+                  f"wait={st.get('wait_total_s', 0.0):.3f}s "
+                  f"xfer={st.get('xfer_total_s', 0.0):.3f}s "
+                  f"max_wait={st.get('wait_max_s', 0.0):.3f}s "
+                  f"straggler_waves={st.get('straggler_waves', 0)}",
+                  file=out)
+
+
 def report_summary(s, out=sys.stdout):
     """Host-side span table from a summary dict, then the device-time
     attribution section when the run was profiled."""
@@ -155,6 +183,7 @@ def report_summary(s, out=sys.stdout):
     hranks = s.get("health") if "ranks" in (s.get("health") or {}) else None
     health_block(s.get("events", {}), s.get("counters", {}),
                  state=hstate, ranks=hranks, out=out)
+    collective_skew_block(s.get("collective_skew"), out=out)
     da = s.get("device_attribution")
     if da:
         print("\n== device attribution (LGBM_TPU_PROFILE capture) ==",
