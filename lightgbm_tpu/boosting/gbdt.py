@@ -570,6 +570,10 @@ class GBDT:
         self._block_fns: Dict[int, object] = {}
         self._block_len_uses: Dict[int, int] = {}
         self._block_compiling: set = set()
+        # live background-compile threads (bounded-shutdown contract:
+        # join_background reaps them; non-daemon by design, see
+        # _spawn_block_compile)
+        self._bg_threads: list = []
         # how often the host checks trees for the no-more-splits stop
         # (reference checks every iteration, gbdt.cpp:435-470; through a
         # remote tunnel each check is a ~100ms round-trip)
@@ -1396,8 +1400,25 @@ class GBDT:
         import threading
         # NON-daemon: a daemon thread mid-XLA-compile at interpreter
         # shutdown races the runtime teardown and segfaults; a normal
-        # thread just delays exit until the compile lands
-        threading.Thread(target=work, daemon=False).start()
+        # thread just delays exit until the compile lands.  The handle
+        # is kept so join_background can reap it (bounded shutdown)
+        t = threading.Thread(target=work, daemon=False,
+                             name=f"lgbm-tpu-block-compile-{L}")
+        self._bg_threads = [th for th in self._bg_threads
+                            if th.is_alive()]
+        self._bg_threads.append(t)
+        t.start()
+
+    def join_background(self, timeout: Optional[float] = None) -> bool:
+        """Wait for in-flight background block compiles (the bounded-
+        shutdown contract: every spawned thread has a join path).
+        Returns True when none remain; a compile still running after
+        ``timeout`` seconds (per thread) leaves its thread alive —
+        non-daemon, so it still finishes before interpreter exit."""
+        for t in self._bg_threads:
+            t.join(timeout)
+        self._bg_threads = [t for t in self._bg_threads if t.is_alive()]
+        return not self._bg_threads
 
     _BLOCK_CAP = 32
 

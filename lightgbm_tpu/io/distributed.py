@@ -116,6 +116,10 @@ def deadline_call(fn: Callable, site: str,
         counter_add("collective.deadline_exceeded")
         event("elastic", "rank_lost", site=site, deadline_s=deadline)
         raise RankLostError(site, deadline)
+    # success path: `done` is set so the worker is past its useful
+    # life — reap it (bounded-shutdown contract; only the deadline
+    # path above abandons the daemonized thread, by design)
+    t.join(timeout=1.0)
     if "error" in box:
         raise box["error"]
     return box["value"]

@@ -50,7 +50,6 @@ from __future__ import annotations
 
 import json
 import os
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -62,7 +61,9 @@ __all__ = [
     "read_ledger", "reset",
 ]
 
-_lock = threading.Lock()
+from .lock_contract import named_lock
+
+_lock = named_lock("fleet")
 
 
 # ---------------------------------------------------------------------------
@@ -324,7 +325,7 @@ class FleetLedger:
             os.makedirs(d, exist_ok=True)
         self._fd: Optional[int] = os.open(
             path, os.O_WRONLY | os.O_CREAT | os.O_APPEND, 0o644)
-        self._wlock = threading.Lock()
+        self._wlock = named_lock("fleet_ledger")
 
     def put_line(self, kind: str, **fields: Any) -> None:
         # detcheck: disable=DET006 -- ledger lines carry operator-facing wall-clock timestamps; never traced

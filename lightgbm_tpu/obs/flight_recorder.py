@@ -42,7 +42,6 @@ from __future__ import annotations
 
 import hashlib
 import os
-import threading
 from collections import deque
 from typing import Any, Dict, List, Optional, Sequence
 
@@ -51,7 +50,9 @@ __all__ = [
     "cross_check_summaries", "window_check", "dump_to_summary",
 ]
 
-_lock = threading.Lock()
+from .lock_contract import named_lock
+
+_lock = named_lock("flight_recorder")
 _CAP = max(8, int(os.environ.get("LGBM_TPU_FR_CAP", "128") or 128))
 _ring: "deque[Dict[str, Any]]" = deque(maxlen=_CAP)
 _count = 0                      # entries ever recorded (ring may be smaller)

@@ -61,8 +61,10 @@ __all__ = [
     "check_scores", "check_metrics", "forensic_path", "write_forensic",
 ]
 
-_lock = threading.RLock()
-_active = False                  # flipped by the ops plane / watchdog /
+from .lock_contract import named_condition, named_rlock
+
+_lock = named_rlock("health")
+_active = False                 # flipped by the ops plane / watchdog /
 #                                  sentinels: mark_* are no-ops otherwise
 # ordered by severity: a transition may only move DOWN this list via
 # explicit reset (stalled/degraded are sticky — a scraper that polls
@@ -264,7 +266,7 @@ class Watchdog:
         self.plane = plane
         self.deadline_s = float(deadline_s)
         self.fired = threading.Event()      # latest arm's expiry flag
-        self._cv = threading.Condition()
+        self._cv = named_condition("watchdog")
         self._armed: Optional[tuple] = None  # (seq, span, attrs, deadline)
         self._seq = 0
         self._stop = False

@@ -47,6 +47,8 @@ from typing import Any, Callable, Dict, List, Optional
 
 import numpy as np
 
+from .lock_contract import named_lock
+
 __all__ = [
     "RollingQuantiles", "MetricsRegistry", "OpsPlane", "enabled",
     "mount", "plane", "shutdown", "sketch_cap",
@@ -119,11 +121,15 @@ class MetricsRegistry:
     lock on the write path, alone on the render path."""
 
     def __init__(self):
-        self._lock = threading.Lock()
+        self._lock = named_lock("metrics_registry")
         self.counters: Dict[str, float] = {}
         self.gauges: Dict[str, Any] = {}
         self.events: Dict[str, int] = {}
         self.spans: Dict[str, RollingQuantiles] = {}
+        # per-lock wait sketches + contended counts, fed by the runtime
+        # lock contract (obs/lock_contract.py) when it is armed
+        self.lock_waits: Dict[str, RollingQuantiles] = {}
+        self.lock_contended: Dict[str, int] = {}
 
     # -- sink interface (called from telemetry, under its lock) ---------
     def counter(self, name: str, add: float, value: float) -> None:
@@ -145,6 +151,17 @@ class MetricsRegistry:
                 sk = self.spans[name] = RollingQuantiles()
             sk.observe(dur_s)
 
+    def lock_wait(self, name: str, wait_s: float,
+                  contended: bool = False) -> None:
+        with self._lock:
+            sk = self.lock_waits.get(name)
+            if sk is None:
+                sk = self.lock_waits[name] = RollingQuantiles()
+            sk.observe(wait_s)
+            if contended:
+                self.lock_contended[name] = \
+                    self.lock_contended.get(name, 0) + 1
+
     # -- render ---------------------------------------------------------
     def render_prometheus(self) -> str:
         from . import health
@@ -155,6 +172,9 @@ class MetricsRegistry:
             events = dict(self.events)
             sketches = {k: (v.count, v.quantiles())
                         for k, v in self.spans.items()}
+            lock_sketches = {k: (v.count, v.quantiles())
+                             for k, v in self.lock_waits.items()}
+            lock_contended = dict(self.lock_contended)
         for name in sorted(counters):
             mn = f"lgbm_tpu_{_sanitize(name)}_total"
             out.append(f"# TYPE {mn} counter")
@@ -184,6 +204,25 @@ class MetricsRegistry:
                         f'quantile="{qv / 100.0:g}"}} {_fmt(val)}')
                 out.append(
                     f'lgbm_tpu_span_seconds_count{{span="{sn}"}} {count}')
+        if lock_sketches:
+            out.append("# TYPE lgbm_tpu_lock_wait_seconds summary")
+            for name in sorted(lock_sketches):
+                count, q = lock_sketches[name]
+                ln = _sanitize(name)
+                for qv, val in sorted(q.items()):
+                    out.append(
+                        f'lgbm_tpu_lock_wait_seconds{{lock="{ln}",'
+                        f'quantile="{qv / 100.0:g}"}} {_fmt(val)}')
+                out.append(
+                    f'lgbm_tpu_lock_wait_seconds_count{{lock="{ln}"}} '
+                    f'{count}')
+        if lock_contended:
+            out.append("# TYPE lgbm_tpu_lock_contended_total counter")
+            for name in sorted(lock_contended):
+                out.append(
+                    f'lgbm_tpu_lock_contended_total'
+                    f'{{lock="{_sanitize(name)}"}} '
+                    f'{lock_contended[name]}')
         st = health.state()
         out.append("# TYPE lgbm_tpu_health_state gauge")
         for s in ("warming", "ready", "draining", "degraded", "stalled"):
@@ -252,6 +291,9 @@ class OpsPlane:
         self.t0 = time.time()
         self.owners: set = set()
         self.registry = MetricsRegistry()
+        # registered from the owning (main) thread, swapped out by the
+        # HTTP /drain thread: the hook list needs its own leaf lock
+        self._hooks_lock = named_lock("ops_drain")
         self._drain_hooks: List[Callable[[], Any]] = []
         self._server = ThreadingHTTPServer(
             ("127.0.0.1", int(port)), _Handler.build(self))
@@ -269,13 +311,15 @@ class OpsPlane:
                  f"(/metrics /healthz /drain)")
 
     def register_drain(self, fn: Callable[[], Any]) -> None:
-        self._drain_hooks.append(fn)
+        with self._hooks_lock:
+            self._drain_hooks.append(fn)
 
     def drain(self) -> Dict[str, Any]:
         """Run every registered drain hook (serving: stop accepting,
         flush the queue) and report.  Idempotent — hooks run once."""
         from . import health
-        hooks, self._drain_hooks = self._drain_hooks, []
+        with self._hooks_lock:
+            hooks, self._drain_hooks = self._drain_hooks, []
         health.mark_draining(requested=True)
         reports = []
         for fn in hooks:
@@ -296,7 +340,7 @@ class OpsPlane:
         self._thread.join(timeout=5.0)
 
 
-_lock = threading.Lock()
+_lock = named_lock("ops_plane")
 _plane: Optional[OpsPlane] = None
 
 

@@ -57,7 +57,13 @@ __all__ = [
     "set_clock_offset", "set_rank",
 ]
 
-_lock = threading.RLock()
+def _named_rlock(name: str):
+    # lazy: lock_contract imports only stdlib, so this is cycle-free
+    from . import lock_contract
+    return lock_contract.named_rlock(name)
+
+
+_lock = _named_rlock("telemetry")
 _tls = threading.local()            # per-thread span stack
 
 # -- state (module-level flags keep the disabled path one attribute read)
@@ -126,6 +132,13 @@ def set_sink(sink) -> None:
     — the plane's lifecycle is the process, not one run."""
     global _sink
     _sink = sink
+
+
+def get_sink():
+    """The installed sink or None.  Lock-free single attribute read —
+    ``obs/lock_contract.py`` calls this from inside lock wrappers, so
+    it must never take the telemetry lock."""
+    return _sink
 
 
 def _rank_world():

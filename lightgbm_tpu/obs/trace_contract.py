@@ -72,7 +72,8 @@ class CompileTracker:
         self._handler = _Handler(self)
         self._events: List[Dict[str, Any]] = []
         self._steady_idx: Optional[int] = None
-        self._lock = threading.Lock()
+        from .lock_contract import named_lock
+        self._lock = named_lock("trace_contract")
         self._track_threads = track_threads
         self._main_thread: Optional[int] = None
         self._prev_flag: Optional[bool] = None

@@ -159,7 +159,8 @@ class ElasticCoordinator:
         # (LGBM_TPU_FLEET_LEDGER or the constructor)
         path = ledger_path or obs_fleet.ledger_path_env()
         self._ledger = obs_fleet.FleetLedger(path) if path else None
-        self._cv = threading.Condition()
+        from ..obs.lock_contract import named_condition
+        self._cv = named_condition("elastic_coord")
         self._members: Dict[str, _Member] = {}   # member id -> _Member
         self._generation = 0
         self._join_seq = 0
@@ -240,6 +241,12 @@ class ElasticCoordinator:
             self._cv.notify_all()
         self._server.shutdown()
         self._server.server_close()
+        # bounded-shutdown contract: every spawned thread gets a
+        # join(timeout) — the server thread exits with shutdown(), the
+        # monitor wakes on the notify above and sees _stop
+        for t in self._threads:
+            t.join(timeout=5.0)
+        self._threads = []
         self._ledger_put("coordinator_stop")
         if self._ledger is not None:
             self._ledger.close()
@@ -265,11 +272,15 @@ class ElasticCoordinator:
 
     # -- internals -----------------------------------------------------
     def _ranks(self) -> Dict[str, int]:
-        """member id -> rank: contiguous 0..W-1 in join order (a shrink
-        re-ranks survivors — every rank map is per-generation and
-        clients re-learn theirs on resync)."""
-        order = sorted(self._members.values(), key=lambda m: m.joined_seq)
-        return {m.member: r for r, m in enumerate(order)}
+        """member id -> rank: contiguous 0..W-1 in sorted member-id
+        order — a pure function of the membership SET, so concurrent
+        joiners racing into the same generation get the same rank map
+        no matter which socket thread lands first (the join-order
+        scheme this replaces handed out ranks by arrival, which two
+        deflaked tests had to poll around).  A shrink re-ranks
+        survivors — every rank map is per-generation and clients
+        re-learn theirs on resync.  Caller holds ``_cv``."""
+        return {m: r for r, m in enumerate(sorted(self._members))}
 
     def _bump(self, why: str, **attrs) -> None:
         """Membership changed: new generation, fail the old one's
@@ -527,7 +538,11 @@ class ElasticClient:
         self.generation = -1
         # churn the heartbeat thread has SEEN but this client has not
         # yet adopted; only _adopt mutates (generation, seq) — the pair
-        # keys collective rounds and must move together on every member
+        # keys collective rounds and must move together on every member.
+        # _seen_generation is written by BOTH the heartbeat thread and
+        # the main thread, so it gets its own leaf lock
+        from ..obs.lock_contract import named_lock
+        self._state_lock = named_lock("elastic_client")
         self._seen_generation = -1
         self.seq = 0
         self._status: Dict[str, Any] = {}
@@ -631,7 +646,8 @@ class ElasticClient:
         self.world = int(resp["world"])
         self.rank = int(resp["rank"])
         self.generation = int(resp["generation"])
-        self._seen_generation = self.generation
+        with self._state_lock:
+            self._seen_generation = self.generation
         # unconditional: every member re-adopts after an interrupt, so
         # resetting only on a generation change would leave a member
         # whose view was already current (e.g. the heartbeat saw the
@@ -674,7 +690,8 @@ class ElasticClient:
         adopted (collectives run under it) or merely seen by the
         heartbeat thread (collectives of the adopted generation are
         doomed; :class:`ElasticRun` fails them eagerly)."""
-        return max(self.generation, self._seen_generation)
+        with self._state_lock:
+            return max(self.generation, self._seen_generation)
 
     def leave(self) -> None:
         self._hb_stop.set()
@@ -804,9 +821,10 @@ class ElasticClient:
                 # observe membership churn between collectives; the
                 # client ADOPTS it only via resync/_adopt (which also
                 # resets seq — the two must never move separately)
-                self._seen_generation = max(self._seen_generation,
-                                            int(resp.get("generation",
-                                                         -1)))
+                with self._state_lock:
+                    self._seen_generation = max(
+                        self._seen_generation,
+                        int(resp.get("generation", -1)))
 
 
 class ElasticRun:

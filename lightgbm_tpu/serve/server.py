@@ -111,7 +111,8 @@ class PredictionServer:
             else _default_buckets(self.max_batch, self.min_bucket)
         self._retry = retry_policy
         self._q: "queue.Queue" = queue.Queue()
-        self._lock = threading.Lock()
+        from ..obs.lock_contract import named_lock
+        self._lock = named_lock("serve")
         self._closed = False
         self._n_submitted = 0
         self._n_resolved = 0
@@ -229,13 +230,19 @@ class PredictionServer:
             rows = rows[None, :]
         if not self.binned:
             rows = np.ascontiguousarray(rows, np.float32)
+        req = _Request(rows)
         with self._lock:
             if self._closed:
                 raise RuntimeError("PredictionServer is closed")
             self._n_submitted += 1
-        req = _Request(rows)
+            # queue under the admission lock: close() flips _closed
+            # under the same lock before posting the drain sentinel, so
+            # every admitted request is queued ahead of the drain and
+            # its future always resolves (a put outside the lock can
+            # land after the worker drained and exited, stranding the
+            # future — tools/interleave.py seam "server")
+            self._q.put(req)
         counter_add("serve.requests")
-        self._q.put(req)
         return req.future
 
     def predict(self, x: np.ndarray, timeout: Optional[float] = 60.0):

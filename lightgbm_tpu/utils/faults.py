@@ -97,14 +97,17 @@ must pass straight through the retry layer.
 from __future__ import annotations
 
 import os
-import threading
 from typing import Dict, Optional
 
 POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
           "loader.read", "spmd.skip_record", "serve.score", "mem.leak",
           "det.rng_drift", "watchdog.stall", "health.nan_grad",
           "ingest.shard_fetch", "ingest.cache_write", "collective.hang",
-          "rendezvous.drop_rank", "heartbeat.miss", "collective.slow")
+          "rendezvous.drop_rank", "heartbeat.miss", "collective.slow",
+          # sleeps while holding a contract-named lock
+          # (obs/lock_contract.py): drives the contention-metric and
+          # held-past-deadline paths in tests
+          "lock.slow_hold")
 
 
 class FaultInjected(RuntimeError):
@@ -128,7 +131,14 @@ class _Arm:
         self.transient = transient
 
 
-_lock = threading.Lock()
+def _named_lock(name: str):
+    # lazy: utils.faults sits at the bottom of the import graph, and
+    # lock_contract imports only the stdlib — cycle-free either way
+    from ..obs.lock_contract import named_lock
+    return named_lock(name)
+
+
+_lock = _named_lock("faults")
 _arms: Dict[str, _Arm] = {}
 _fired: Dict[str, int] = {}
 _calls: Dict[str, int] = {}

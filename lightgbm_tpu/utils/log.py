@@ -17,7 +17,6 @@ from __future__ import annotations
 
 import logging
 import sys
-import threading
 from typing import Set
 
 _logger = logging.getLogger("lightgbm_tpu")
@@ -27,7 +26,14 @@ if not _logger.handlers:
     _logger.addHandler(_handler)
     _logger.setLevel(logging.INFO)
 
-_once_lock = threading.Lock()
+def _named_lock(name: str):
+    # lazy: lock_contract imports only the stdlib, so even this
+    # bottom-of-the-graph module can take a contract-named lock
+    from ..obs.lock_contract import named_lock
+    return named_lock(name)
+
+
+_once_lock = _named_lock("log_once")
 _once_seen: Set[str] = set()
 
 

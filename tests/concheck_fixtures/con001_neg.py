@@ -1,0 +1,30 @@
+"""CON001 negative: guarded writes under the lock, or from functions no
+thread can reach, are clean."""
+import threading
+
+CONCHECK_LOCKS = {"_lock": ("_state",)}
+
+_lock = threading.Lock()
+_state = None
+
+
+def _c1n_set_state(value):
+    # not thread-reachable: main-thread-only writers are not flagged
+    global _state
+    _state = value
+
+
+def _c1n_set_state_locked(value):
+    global _state
+    with _lock:
+        _state = value
+
+
+def _c1n_refresher():
+    _c1n_set_state_locked(1)
+
+
+def _c1n_spawn():
+    t = threading.Thread(target=_c1n_refresher, daemon=True)
+    t.start()
+    t.join(timeout=5.0)

@@ -1,0 +1,30 @@
+"""CON001 positive: a registered guarded name written without its lock
+from a thread-reachable function."""
+import threading
+
+CONCHECK_LOCKS = {"_lock": ("_count",)}
+
+_lock = threading.Lock()
+_count = 0
+
+
+def _c1p_bump_unlocked():
+    global _count
+    _count = _count + 1                           # EXPECT: CON001
+
+
+def _c1p_bump_locked():
+    global _count
+    with _lock:
+        _count = _count + 1
+
+
+def _c1p_worker():
+    _c1p_bump_unlocked()
+    _c1p_bump_locked()
+
+
+def _c1p_spawn():
+    t = threading.Thread(target=_c1p_worker)
+    t.start()
+    t.join(timeout=5.0)

@@ -1,0 +1,29 @@
+"""CON002 negative: nesting along declared DAG edges (including a
+transitive path) and rlock re-entry are clean."""
+import threading
+
+CONCHECK_LOCKS = {"_outer": (), "_mid": (), "_leaf": ()}
+CONCHECK_ORDER = (("_outer", "_mid"), ("_mid", "_leaf"))
+
+_outer = threading.Lock()
+_mid = threading.Lock()
+_leaf = threading.Lock()
+_re = threading.RLock()
+
+
+def _c2n_declared_edge():
+    with _outer:
+        with _mid:
+            pass
+
+
+def _c2n_transitive_path():
+    with _outer:
+        with _leaf:
+            pass
+
+
+def _c2n_rlock_reentry():
+    with _re:
+        with _re:
+            pass

@@ -1,0 +1,24 @@
+"""CON002 positive: lock nesting with no path in the declared order
+DAG — lexically and through a callee's lock closure."""
+import threading
+
+CONCHECK_LOCKS = {"_lock_a": (), "_lock_b": ()}
+
+_lock_a = threading.Lock()
+_lock_b = threading.Lock()
+
+
+def _c2p_nested_undeclared():
+    with _lock_a:
+        with _lock_b:                             # EXPECT: CON002
+            pass
+
+
+def _c2p_acquires_b():
+    with _lock_b:
+        pass
+
+
+def _c2p_calls_into_b():
+    with _lock_a:
+        _c2p_acquires_b()                         # EXPECT: CON002

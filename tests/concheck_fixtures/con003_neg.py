@@ -1,0 +1,26 @@
+"""CON003 negative: bounded waits under a lock, wait() on the held
+condition itself, and blocking calls outside any lock are clean."""
+import threading
+import time
+
+CONCHECK_LOCKS = {"_cv": ("_ready",)}
+
+_cv = threading.Condition()
+_ready = False
+
+
+def _c3n_waits_on_held_condition():
+    global _ready
+    with _cv:
+        while not _ready:
+            _cv.wait()            # the held condition: that's its job
+        _ready = False
+
+
+def _c3n_bounded_wait(evt):
+    with _cv:
+        evt.wait(timeout=0.1)
+
+
+def _c3n_sleeps_unlocked():
+    time.sleep(0.01)

@@ -1,0 +1,16 @@
+"""CON003 positive: blocking calls while a lock is held."""
+import subprocess
+import threading
+import time
+
+CONCHECK_LOCKS = {"_io_lock": ()}
+
+_io_lock = threading.Lock()
+_done = threading.Event()
+
+
+def _c3p_slow_under_lock():
+    with _io_lock:
+        time.sleep(0.1)                           # EXPECT: CON003
+        _done.wait()                              # EXPECT: CON003
+        subprocess.check_output(["true"])         # EXPECT: CON003

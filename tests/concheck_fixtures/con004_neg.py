@@ -1,0 +1,38 @@
+"""CON004 negative: the bounded-shutdown shapes — direct join,
+container-flow join, and list-literal handoff — are clean."""
+import threading
+
+
+def _c4n_work():
+    pass
+
+
+def _c4n_run_joined():
+    t = threading.Thread(target=_c4n_work)
+    t.start()
+    t.join(timeout=2.0)
+
+
+class _C4nPool:
+    def __init__(self):
+        self._threads = []
+
+    def spawn(self, n):
+        for _ in range(n):
+            w = threading.Thread(target=_c4n_work, daemon=True)
+            w.start()
+            self._threads.append(w)
+
+    def shutdown(self, timeout=1.0):
+        for w in self._threads:
+            w.join(timeout)
+
+
+def _c4n_run_pair():
+    a = threading.Thread(target=_c4n_work)
+    b = threading.Thread(target=_c4n_work)
+    a.start()
+    b.start()
+    pair = [a, b]
+    for th in pair:
+        th.join(timeout=1.0)

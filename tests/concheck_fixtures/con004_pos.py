@@ -1,0 +1,15 @@
+"""CON004 positive: started threads with no stop/join path."""
+import threading
+
+
+def _c4p_work():
+    pass
+
+
+def _c4p_leak_daemon():
+    t = threading.Thread(target=_c4p_work, daemon=True)  # EXPECT: CON004
+    t.start()
+
+
+def _c4p_fire_and_forget():
+    threading.Thread(target=_c4p_work).start()    # EXPECT: CON004

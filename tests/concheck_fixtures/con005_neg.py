@@ -1,0 +1,24 @@
+"""CON005 negative: a callback declared safe (with its why), and a
+callback invoked outside any lock, are clean."""
+import threading
+
+CONCHECK_LOCKS = {"_lock5n": ()}
+CONCHECK_CALLBACKS = {
+    "_safe_sink": "declared safe: leaf sink, never re-enters this module",
+}
+
+_lock5n = threading.Lock()
+_safe_sink = None
+_handler = None
+
+
+def _c5n_notify_safe(payload):
+    with _lock5n:
+        if _safe_sink is not None:
+            _safe_sink(payload)
+
+
+def _c5n_notify_outside(payload):
+    cb = _handler
+    if cb is not None:
+        cb(payload)

@@ -1,0 +1,20 @@
+"""CON005 positive: a declared callback seam invoked while holding a
+lock, with no safe justification."""
+import threading
+
+CONCHECK_LOCKS = {"_lock5": ()}
+CONCHECK_CALLBACKS = ("_sink",)
+
+_lock5 = threading.Lock()
+_sink = None
+
+
+def _c5p_set_sink(cb):
+    global _sink
+    _sink = cb
+
+
+def _c5p_notify(payload):
+    with _lock5:
+        if _sink is not None:
+            _sink(payload)                        # EXPECT: CON005

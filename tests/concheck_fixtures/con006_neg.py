@@ -1,0 +1,19 @@
+"""CON006 negative: double-checked locking (the act re-validates the
+flag under the lock) is clean."""
+import threading
+
+CONCHECK_LOCKS = {"_lock6n": ("_ready6", "_cache6")}
+
+_lock6n = threading.Lock()
+_ready6 = False
+_cache6 = None
+
+
+def _c6n_ensure_cache():
+    global _ready6, _cache6
+    if not _ready6:
+        with _lock6n:
+            if not _ready6:
+                _cache6 = object()
+                _ready6 = True
+    return _cache6

@@ -1,0 +1,16 @@
+"""CON006 positive: check-then-act — a guarded flag tested without the
+lock deciding an equally unlocked write to the same lock's state."""
+import threading
+
+CONCHECK_LOCKS = {"_lock6": ("_initialized", "_resource")}
+
+_lock6 = threading.Lock()
+_initialized = False
+_resource = None
+
+
+def _c6p_ensure_resource():
+    global _initialized, _resource
+    if not _initialized:                          # EXPECT: CON006
+        _resource = object()
+        _initialized = True

@@ -1,0 +1,227 @@
+"""Tier-1 gate: concheck thread & lock discipline analysis.
+
+Mirrors the tpulint/spmdcheck/memcheck/detcheck gate layers:
+
+1. **Package gate** — ``lightgbm_tpu/`` must analyze clean against the
+   committed baseline (``tools/concheck/baseline.json``, EMPTY), via
+   the shared umbrella run (``tools.check.cached_run_all``: one AST
+   parse serves all five static gates in a pytest session).
+2. **Rule correctness** — fixtures under ``concheck_fixtures/`` carry
+   ``# EXPECT: CONxxx`` markers; the analyzer must report EXACTLY the
+   marked (line, rule) pairs.
+3. **Seeded hazards** — the acceptance patterns (ISSUE 18): an
+   unguarded write to registry-guarded state from a thread entry point
+   seeded into a copy of ``flight_recorder.py`` fails the gate with
+   CON001 at the right file:line, and a reversed-nesting (static ABBA)
+   pair seeded into ``health.py`` fails with CON002 naming BOTH sites.
+4. **Registry plumbing** — every declared lock names a real module,
+   the ORDER DAG only references declared locks, and the names line up
+   with the runtime contract (``obs/lock_contract.py`` constructs its
+   locks under the same registry names).
+"""
+import os
+import shutil
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURES = os.path.join(REPO, "tests", "concheck_fixtures")
+
+from tools.analysis_core import assert_fixtures_match  # noqa: E402
+from tools.concheck import (BASELINE_DEFAULT, load_baseline,  # noqa: E402
+                            new_findings, run_concheck, write_baseline)
+
+
+# ---------------------------------------------------------------------------
+# 1. package gate (through the shared umbrella run)
+# ---------------------------------------------------------------------------
+def test_package_clean_vs_baseline():
+    from tools.check import cached_run_all
+    _, fresh = cached_run_all(REPO)["concheck"]
+    assert not fresh, ("new concheck findings (fix, suppress with "
+                       "justification, or --update-baseline):\n"
+                       + "\n".join(f.render() for f in fresh))
+
+
+def test_committed_baseline_is_empty():
+    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    assert baseline == {}, ("the concheck baseline must stay EMPTY — "
+                            "fix or justify-suppress instead of pinning: "
+                            f"{baseline}")
+
+
+# ---------------------------------------------------------------------------
+# 2. rule correctness on fixtures
+# ---------------------------------------------------------------------------
+def test_fixtures_match_expect_markers():
+    findings, _ = run_concheck([FIXTURES], root=REPO,
+                               project_rules=False)
+    checked = assert_fixtures_match(FIXTURES, findings)
+    assert checked >= 12    # pos+neg per rule
+
+
+def test_suppression_clears_finding(tmp_path):
+    mod = tmp_path / "mod.py"
+    mod.write_text(
+        "import threading\n\n"
+        "CONCHECK_LOCKS = {\"_lk\": (\"_n\",)}\n\n"
+        "_lk = threading.Lock()\n"
+        "_n = 0\n\n\n"
+        "def handle():\n"
+        "    global _n\n"
+        "    # concheck: disable=CON001 -- single-writer by protocol:\n"
+        "    # only the accept loop ever calls handle()\n"
+        "    _n = _n + 1\n")
+    findings, _ = run_concheck(["mod.py"], root=str(tmp_path),
+                               project_rules=False)
+    assert not findings, [f.render() for f in findings]
+
+
+def test_baseline_roundtrip(tmp_path):
+    mod = tmp_path / "mod.py"
+    shutil.copy(os.path.join(FIXTURES, "con002_pos.py"), mod)
+    findings, by_rel = run_concheck(["mod.py"], root=str(tmp_path),
+                                    project_rules=False)
+    assert findings
+    bl_path = tmp_path / "baseline.json"
+    write_baseline(str(bl_path), findings, by_rel)
+    again, by_rel2 = run_concheck(["mod.py"], root=str(tmp_path),
+                                  project_rules=False)
+    assert not new_findings(again, by_rel2, load_baseline(str(bl_path)))
+    # a NEW hazard (distinct line text) surfaces through the pin
+    mod.write_text(mod.read_text() + (
+        "\n\ndef _c2p_fresh_hazard():\n"
+        "    with _lock_b:\n"
+        "        with _lock_a:\n"
+        "            pass\n"))
+    third, by_rel3 = run_concheck(["mod.py"], root=str(tmp_path),
+                                  project_rules=False)
+    fresh = new_findings(third, by_rel3, load_baseline(str(bl_path)))
+    assert len(fresh) == 1 and fresh[0].rule == "CON002", \
+        [f.render() for f in fresh]
+
+
+# ---------------------------------------------------------------------------
+# 3. seeded hazards (the acceptance patterns)
+# ---------------------------------------------------------------------------
+# `handle` is a thread-entry name (socket handler convention), and
+# `_count` is registered as guarded by the flight_recorder lock: the
+# pre-registry shape where a handler pokes shared state bare.
+CON001_SEED = (
+    "\n\ndef handle():\n"
+    "    global _count\n"
+    "    _count = _count + 1  # concheck probe write\n")
+
+# Classic static ABBA: two fresh locks nested in both orders with no
+# ORDER edge — each inner acquisition is a CON002 naming both sites.
+CON002_SEED = (
+    "\n\n_probe_a = threading.Lock()\n"
+    "_probe_b = threading.Lock()\n\n\n"
+    "def _con_probe_ab():\n"
+    "    with _probe_a:\n"
+    "        with _probe_b:  # probe inner ab\n"
+    "            pass\n\n\n"
+    "def _con_probe_ba():\n"
+    "    with _probe_b:\n"
+    "        with _probe_a:  # probe inner ba\n"
+    "            pass\n")
+
+
+def _seed_package(tmp_path, rel, seed_text, marker):
+    pkg = tmp_path / "lightgbm_tpu"
+    if not pkg.exists():
+        shutil.copytree(os.path.join(REPO, "lightgbm_tpu"), pkg,
+                        ignore=shutil.ignore_patterns("__pycache__"))
+    target = pkg / rel
+    target.write_text(target.read_text() + seed_text)
+    lines = target.read_text().splitlines()
+    return [i + 1 for i, ln in enumerate(lines) if marker in ln][-1]
+
+
+def test_seeded_hazards_fail_gate(tmp_path):
+    """Acceptance, both seeded hazards in one package copy (one
+    analyzer pass + one CLI run — the suite pays for package-sized
+    concheck passes, so don't run two where one proves both):
+
+    * an unguarded write to '_count' (registered to the
+      flight_recorder lock) from a thread entry point fails the gate
+      with CON001 at the correct file:line;
+    * a reversed-nesting lock pair (static ABBA) seeded into health.py
+      fails with CON002 on BOTH inner acquisitions, each finding
+      naming the held lock and the line it was acquired on (the two
+      sites of the would-be deadlock)."""
+    hazard_line = _seed_package(
+        tmp_path, os.path.join("obs", "flight_recorder.py"), CON001_SEED,
+        "# concheck probe write")
+    line_ab = _seed_package(
+        tmp_path, os.path.join("obs", "health.py"), CON002_SEED,
+        "# probe inner ab")
+    target = tmp_path / "lightgbm_tpu" / "obs" / "health.py"
+    lines = target.read_text().splitlines()
+    line_ba = [i + 1 for i, ln in enumerate(lines)
+               if "# probe inner ba" in ln][-1]
+
+    findings, by_rel = run_concheck(["lightgbm_tpu"], root=str(tmp_path))
+    baseline = load_baseline(os.path.join(REPO, BASELINE_DEFAULT))
+    fresh = new_findings(findings, by_rel, baseline)
+    assert any(f.rule == "CON001"
+               and f.file == "lightgbm_tpu/obs/flight_recorder.py"
+               and f.line == hazard_line for f in fresh), \
+        [f.render() for f in fresh]
+    hits = [f for f in fresh if f.rule == "CON002"
+            and f.file == "lightgbm_tpu/obs/health.py"]
+    assert {f.line for f in hits} >= {line_ab, line_ba}, \
+        [f.render() for f in fresh]
+    # each finding carries BOTH sites: the inner acquisition (its line)
+    # and the outer acquisition line embedded in the message
+    ab = next(f for f in hits if f.line == line_ab)
+    ba = next(f for f in hits if f.line == line_ba)
+    assert "_probe_a" in ab.message and "_probe_b" in ab.message
+    assert f"line {line_ab - 1}" in ab.message, ab.message
+    assert f"line {line_ba - 1}" in ba.message, ba.message
+
+    # ... and the CLI exits non-zero printing file:line + rule id
+    proc = subprocess.run(
+        [sys.executable, "-m", "tools.concheck", "--root", str(tmp_path),
+         "lightgbm_tpu"],
+        cwd=REPO, capture_output=True, text=True)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert (f"lightgbm_tpu/obs/flight_recorder.py:{hazard_line}: CON001"
+            in proc.stdout), proc.stdout
+    assert (f"lightgbm_tpu/obs/health.py:{line_ab}: CON002"
+            in proc.stdout), proc.stdout
+    assert (f"lightgbm_tpu/obs/health.py:{line_ba}: CON002"
+            in proc.stdout), proc.stdout
+
+
+# ---------------------------------------------------------------------------
+# 4. registry plumbing: static registry <-> runtime contract coherence
+# ---------------------------------------------------------------------------
+def test_registry_modules_exist_and_order_is_closed():
+    from tools.concheck import lock_registry as reg
+    names = set()
+    for d in reg.LOCKS:
+        assert d["name"] not in names, f"duplicate lock {d['name']}"
+        names.add(d["name"])
+        assert os.path.exists(os.path.join(REPO, d["module"])), d
+        assert d["kind"] in ("lock", "rlock", "condition"), d
+    for outer, inner in reg.ORDER:
+        assert outer in names and inner in names, (outer, inner)
+
+
+def test_registry_names_match_runtime_contract():
+    """Every registry lock constructed through obs/lock_contract.py
+    factories uses its registry name, so a static CON002 edge and a
+    runtime lock-order-cycle report are phrased identically."""
+    from tools.concheck import lock_registry as reg
+    for d in reg.LOCKS:
+        src = open(os.path.join(REPO, d["module"])).read()
+        if "lock_contract" not in src and d["module"].endswith(
+                "lock_contract.py"):
+            continue    # the contract's own graph lock stays raw
+        if f'("{d["name"]}"' in src or f"('{d['name']}'" in src:
+            continue    # named_* factory call carries the registry name
+        # raw locks are allowed only where wrapping would recurse
+        assert d["name"] == "lock_contract", (
+            f"lock '{d['name']}' in {d['module']} is not constructed "
+            f"via a named_* factory carrying its registry name")

@@ -335,15 +335,11 @@ def test_two_process_straggler_localized_by_fleet_report(
     procs = []
     scraped = []
     try:
+        # no registration ordering needed: ranks follow sorted member
+        # id ("worker-0" < "worker-1"), so the straggler is
+        # DETERMINISTICALLY rank 1 however the joins race
         procs.append(_spawn_worker(rundir, spec_path, address,
                                    "worker-0", traces[0], {}))
-        # worker-0 must register first: ranks follow join order, so
-        # the straggler is DETERMINISTICALLY rank 1
-        deadline = time.monotonic() + 60
-        while coord.membership()["world"] < 1 \
-                and time.monotonic() < deadline:
-            time.sleep(0.02)
-        assert coord.membership()["world"] == 1
         procs.append(_spawn_worker(
             rundir, spec_path, address, "worker-1", traces[1],
             {"LGBM_TPU_FAULTS": "collective.slow:9999",

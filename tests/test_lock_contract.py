@@ -1,0 +1,297 @@
+"""Runtime lock-order contract (obs/lock_contract.py) + the interleave
+fuzzer — the dynamic half of concheck (ISSUE 18).
+
+Layers:
+
+1. **Off = raw** — factories return plain ``threading`` primitives when
+   the contract is disarmed (zero hot-path overhead).
+2. **Cycle detection** — an injected ABBA closes the acquisition-order
+   graph and is reported ONLINE (before any schedule wedges), naming
+   both locks and BOTH ``file:line`` acquisition sites.
+3. **Timing contracts** — held-past-deadline (``LGBM_TPU_LOCK_HOLD_S``)
+   with the owner's stack; the ``lock.slow_hold`` fault point drives the
+   same path without a sleep in the test body.
+4. **Guarded values** — ``Guarded.value``/``assign`` off-lock record an
+   ``unguarded-access`` violation with the offender's site (the runtime
+   mirror of CON001).
+5. **Live metrics** — a contended acquire surfaces in a real ``/metrics``
+   scrape as ``lgbm_tpu_lock_wait_seconds{lock,quantile}`` and
+   ``lgbm_tpu_lock_contended_total``.
+6. **Interleave fuzzer** — the toy tier-1 run: every seam clean over a
+   couple of randomized schedules.
+7. **Bounded shutdown** — after train + serve + elastic teardown a
+   subprocess exits promptly with no surviving package threads.
+"""
+import os
+import re
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+from lightgbm_tpu import obs  # noqa: E402
+from lightgbm_tpu.obs import lock_contract as lc  # noqa: E402
+from lightgbm_tpu.obs import ops_plane  # noqa: E402
+from lightgbm_tpu.utils import faults  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean():
+    lc.reset()
+    faults.clear()
+    yield
+    ops_plane.shutdown()
+    faults.clear()
+    lc.reset()
+    obs.reset()
+
+
+def _armed(monkeypatch):
+    monkeypatch.setenv("LGBM_TPU_LOCK_CONTRACT", "1")
+
+
+# ---------------------------------------------------------------------------
+# 1. disarmed = raw primitives
+# ---------------------------------------------------------------------------
+def test_disabled_returns_raw_primitives(monkeypatch):
+    monkeypatch.delenv("LGBM_TPU_LOCK_CONTRACT", raising=False)
+    assert not isinstance(lc.named_lock("x"), lc._ContractBase)
+    assert not isinstance(lc.named_rlock("x"), lc._ContractBase)
+    assert not isinstance(lc.named_condition("x"), lc._ContractBase)
+
+
+def test_enabled_returns_wrapped(monkeypatch):
+    _armed(monkeypatch)
+    assert isinstance(lc.named_lock("x"), lc.ContractLock)
+    assert isinstance(lc.named_rlock("x"), lc.ContractRLock)
+    assert isinstance(lc.named_condition("x"), lc.ContractCondition)
+
+
+# ---------------------------------------------------------------------------
+# 2. online ABBA detection with both sites
+# ---------------------------------------------------------------------------
+def test_abba_cycle_named_with_both_sites(monkeypatch):
+    """The acceptance pattern: one thread nests probe_a -> probe_b, a
+    second nests probe_b -> probe_a; the closing edge is reported the
+    moment it appears — no schedule has to actually wedge — naming
+    every hop with its file:line."""
+    _armed(monkeypatch)
+    a = lc.named_lock("probe_a")
+    b = lc.named_lock("probe_b")
+
+    def order_ab():
+        with a:
+            with b:
+                pass
+
+    t = threading.Thread(target=order_ab)
+    t.start()
+    t.join(timeout=10.0)
+    assert not lc.violations()      # one order alone is legal
+
+    with b:
+        with a:                     # closes the cycle
+            pass
+
+    cycles = [v for v in lc.violations()
+              if v["kind"] == "lock-order-cycle"]
+    assert len(cycles) == 1, lc.violations()
+    v = cycles[0]
+    assert set(v["cycle"]) == {"probe_a", "probe_b"}
+    # BOTH acquisition sites of every hop, as file:line in THIS file
+    sites = re.findall(r"probe_[ab]@(test_lock_contract\.py:\d+)",
+                       v["detail"])
+    # four distinct acquisition sites: outer+inner of BOTH orders
+    assert len(set(sites)) == 4, v["detail"]
+
+
+def test_rlock_reentry_and_declared_order_are_clean(monkeypatch):
+    _armed(monkeypatch)
+    r = lc.named_rlock("probe_r")
+    inner = lc.named_lock("probe_inner")
+    with r:
+        with r:                     # re-entry: never an edge
+            with inner:             # one consistent order: no cycle
+                pass
+    assert not lc.violations()
+
+
+# ---------------------------------------------------------------------------
+# 3. timing contracts
+# ---------------------------------------------------------------------------
+def test_held_past_deadline_reports_owner_stack(monkeypatch):
+    _armed(monkeypatch)
+    monkeypatch.setenv("LGBM_TPU_LOCK_HOLD_S", "0.01")
+    lk = lc.named_lock("probe_hold")
+    with lk:
+        time.sleep(0.05)
+    held = [v for v in lc.violations()
+            if v["kind"] == "held-past-deadline"]
+    assert len(held) == 1, lc.violations()
+    v = held[0]
+    assert v["lock"] == "probe_hold"
+    assert v["hold_s"] > v["deadline_s"]
+    assert v["thread"] == threading.current_thread().name
+    assert "test_lock_contract.py:" in v["site"]
+    assert "test_lock_contract" in v["stack"]   # acquisition stack
+
+
+def test_slow_hold_fault_point_trips_deadline(monkeypatch):
+    """Satellite 6: ``lock.slow_hold`` injects the hold — no sleep in
+    the test body — and the deadline contract catches it."""
+    _armed(monkeypatch)
+    monkeypatch.setenv("LGBM_TPU_LOCK_HOLD_S", "0.01")
+    lk = lc.named_lock("probe_fault")
+    faults.inject("lock.slow_hold", times=1)
+    with lk:
+        pass
+    held = [v for v in lc.violations()
+            if v["kind"] == "held-past-deadline"]
+    assert held and held[0]["lock"] == "probe_fault", lc.violations()
+
+
+# ---------------------------------------------------------------------------
+# 4. Guarded values (runtime CON001)
+# ---------------------------------------------------------------------------
+def test_guarded_access_without_lock_is_reported(monkeypatch):
+    _armed(monkeypatch)
+    lk = lc.named_lock("probe_g")
+    g = lc.Guarded("counter", lk, 0)
+    with lk:
+        g.assign(g.value() + 1)     # correct discipline: silent
+    assert not lc.violations()
+    g.assign(2)                     # bare write: the violation
+    bad = [v for v in lc.violations() if v["kind"] == "unguarded-access"]
+    assert len(bad) == 1, lc.violations()
+    assert bad[0]["name"] == "counter" and bad[0]["op"] == "write"
+    assert "test_lock_contract.py:" in bad[0]["site"]
+
+
+# ---------------------------------------------------------------------------
+# 5. contention metrics in a LIVE /metrics scrape
+# ---------------------------------------------------------------------------
+def test_contention_metrics_in_live_scrape(monkeypatch):
+    _armed(monkeypatch)
+    monkeypatch.setenv("LGBM_TPU_OPS_PORT", "0")
+    plane = ops_plane.mount("test")
+    assert plane is not None
+    lk = lc.named_lock("probe_scrape")
+    entered = threading.Event()
+
+    def holder():
+        with lk:
+            entered.set()
+            time.sleep(0.05)
+
+    t = threading.Thread(target=holder)
+    t.start()
+    assert entered.wait(10.0)
+    with lk:                        # contended: holder still inside
+        pass
+    t.join(timeout=10.0)
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{plane.port}/metrics", timeout=10) as r:
+        body = r.read().decode()
+    assert re.search(r'lgbm_tpu_lock_wait_seconds\{lock="probe_scrape",'
+                     r'quantile="0\.5"\} ', body), body
+    assert re.search(r'lgbm_tpu_lock_wait_seconds_count'
+                     r'\{lock="probe_scrape"\} \d+', body), body
+    m = re.search(r'lgbm_tpu_lock_contended_total\{lock="probe_scrape"\}'
+                  r' (\d+)', body)
+    assert m and int(m.group(1)) >= 1, body
+
+    snap = lc.snapshot()
+    st = snap["stats"]["probe_scrape"]
+    assert st["contended"] >= 1 and st["acquires"] >= 2
+    assert set(st["wait_quantiles_s"]) == {50.0, 99.0}
+
+
+# ---------------------------------------------------------------------------
+# 6. the interleave fuzzer, toy shape (tier-1)
+# ---------------------------------------------------------------------------
+def test_interleave_toy_run_clean(monkeypatch):
+    """Every seam, two randomized schedules, in-process: clean.  The
+    env is set via monkeypatch BEFORE the import so the module-level
+    ``setdefault`` doesn't leak the flag into the pytest process."""
+    monkeypatch.setenv("LGBM_TPU_LOCK_CONTRACT", "1")
+    from tools.interleave import SEAMS, run_seeds
+    failures = run_seeds(2, list(SEAMS))
+    assert not failures, "\n".join(failures)
+
+
+# ---------------------------------------------------------------------------
+# 7. bounded shutdown: interpreter-exit thread-leak check
+# ---------------------------------------------------------------------------
+_LEAK_SCRIPT = r"""
+import os
+os.environ["LGBM_TPU_LOCK_CONTRACT"] = "1"
+import threading
+import time
+
+import numpy as np
+
+import lightgbm_tpu as lgb
+from lightgbm_tpu.parallel.elastic import ElasticClient, ElasticCoordinator
+from lightgbm_tpu.serve.server import PredictionServer
+
+rng = np.random.RandomState(0)
+X = rng.normal(size=(200, 4)).astype(np.float32)
+y = (X[:, 0] > 0).astype(np.float32)
+bst = lgb.train({"objective": "binary", "num_leaves": 7,
+                 "min_data_in_leaf": 5, "verbose": -1},
+                lgb.Dataset(X, y), num_boost_round=3)
+assert bst._gbdt.join_background(timeout=60.0)
+
+
+class _Stub:
+    def warm(self, buckets, binned=False):
+        pass
+
+    def predict(self, X, raw_score=False, binned=False, pad=False):
+        return np.asarray(X, np.float32).sum(axis=1)
+
+
+srv = PredictionServer(_Stub(), max_batch=16, max_wait_ms=0.5,
+                       warmup=False)
+futs = [srv.submit(np.ones((2, 4), np.float32)) for _ in range(5)]
+srv.close(timeout=30.0)
+assert all(f.done() for f in futs)
+
+coord = ElasticCoordinator(heartbeat_timeout_s=2.0)
+coord.start()
+cli = ElasticClient(coord.address, member="leak-probe", deadline_s=10.0,
+                    heartbeat_interval_s=0.1)
+cli.join_world()
+cli.leave()
+cli.close()
+coord.stop()
+
+deadline = time.monotonic() + 15.0
+while time.monotonic() < deadline:
+    pkg = [t for t in threading.enumerate()
+           if t is not threading.main_thread() and t.is_alive()
+           and (t.name.startswith("lgbm-tpu") or not t.daemon)]
+    if not pkg:
+        break
+    time.sleep(0.05)
+assert not pkg, f"leaked threads: {[t.name for t in pkg]}"
+print("NO_LEAKS")
+"""
+
+
+def test_interpreter_exit_no_thread_leak():
+    """Every thread the package spawns has a bounded shutdown path: a
+    subprocess that trains, serves, and runs an elastic round exits
+    promptly with no surviving package threads (and no non-daemon
+    stragglers that would hang interpreter exit)."""
+    proc = subprocess.run([sys.executable, "-c", _LEAK_SCRIPT],
+                          cwd=REPO, capture_output=True, text=True,
+                          timeout=300)
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "NO_LEAKS" in proc.stdout

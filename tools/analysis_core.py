@@ -1,5 +1,5 @@
 """Shared plumbing for the repo's static analyzers (tpulint, spmdcheck,
-memcheck, detcheck): file loading, one process-wide AST cache, inline
+memcheck, detcheck, concheck): file loading, one process-wide AST cache, inline
 suppression parsing, the content-keyed baseline, and the fixture EXPECT
 matcher.
 
@@ -7,7 +7,7 @@ History: this started life as ``tools/tpulint/core.py`` (PR 3) and was
 imported wholesale by spmdcheck (PR 4).  With memcheck as the third
 consumer the plumbing moved here (``tools/tpulint/core.py`` remains a
 re-export shim so existing imports keep working); detcheck (PR 12) is
-the fourth rider.
+the fourth rider and concheck (PR 18) the fifth.
 
 Design invariants every analyzer relies on:
 
@@ -46,7 +46,7 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 # rule ids (rule-id sets are disjoint, so cross-tag suppression is
 # harmless and occasionally handy when one line trips two analyzers)
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:tpulint|spmdcheck|memcheck|detcheck):\s*disable="
+    r"#\s*(?:tpulint|spmdcheck|memcheck|detcheck|concheck):\s*disable="
     r"([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?\s*$")
 
 # fixture EXPECT markers (tests): `# EXPECT: TPL001` on the flagged

@@ -1,8 +1,8 @@
 """Umbrella static gate: ``python -m tools.check [--root R] [paths...]``.
 
-Runs all four analyzers — tpulint (TPL000-TPL008), spmdcheck
-(SPM001-SPM004), memcheck (MEM001-MEM005), detcheck (DET001-DET006) —
-over ONE shared AST parse (``tools/analysis_core.py``'s process-wide
+Runs all five analyzers — tpulint (TPL000-TPL008), spmdcheck
+(SPM001-SPM004), memcheck (MEM001-MEM005), detcheck (DET001-DET006),
+concheck (CON000-CON006) — over ONE shared AST parse (``tools/analysis_core.py``'s process-wide
 cache: each file is parsed exactly once no matter how many analyzers
 visit it) and diffs each against its own committed baseline.  Exit 0 =
 all clean, 1 = any new finding, 2 = usage error.
@@ -32,8 +32,9 @@ def run_all(paths: Sequence[str] = ("lightgbm_tpu",),
             root: Optional[str] = None,
             project_rules: bool = True,
             ) -> Dict[str, Tuple[List[Finding], List[Finding]]]:
-    """Run the four analyzers over one parse; -> name ->
+    """Run the five analyzers over one parse; -> name ->
     (all_findings, new_vs_baseline)."""
+    from tools.concheck import (BASELINE_DEFAULT as CON_BL, run_concheck)
     from tools.detcheck import (BASELINE_DEFAULT as DET_BL, run_detcheck)
     from tools.memcheck import (BASELINE_DEFAULT as MEM_BL, run_memcheck)
     from tools.spmdcheck import (BASELINE_DEFAULT as SPM_BL, run_spmdcheck)
@@ -52,7 +53,11 @@ def run_all(paths: Sequence[str] = ("lightgbm_tpu",),
             ("detcheck",
              lambda: run_detcheck(paths, root=root,
                                   project_rules=project_rules),
-             DET_BL)):
+             DET_BL),
+            ("concheck",
+             lambda: run_concheck(paths, root=root,
+                                  project_rules=project_rules),
+             CON_BL)):
         findings, by_rel = runner()
         baseline = load_baseline(os.path.join(root, bl))
         out[name] = (findings, new_findings(findings, by_rel, baseline))
@@ -77,7 +82,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.check",
         description="combined static gate: tpulint + spmdcheck + "
-                    "memcheck + detcheck over one shared AST parse")
+                    "memcheck + detcheck + concheck over one shared "
+                    "AST parse")
     parser.add_argument("paths", nargs="*", default=["lightgbm_tpu"])
     parser.add_argument("--root", default=None,
                         help="project root (default: cwd)")

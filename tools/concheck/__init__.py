@@ -1,0 +1,73 @@
+"""concheck — thread & lock discipline analyzer.
+
+The fifth static gate (after tpulint, spmdcheck, memcheck, detcheck),
+aimed at the hazards a threaded substrate breeds: data races on
+guarded state, lock-order inversions, blocking calls inside critical
+sections, leaked threads, callback re-entrancy, and check-then-act
+races.  Rules CON000-CON006 (see ``rules.py``) run as a tier-1 gate
+via ``tests/test_concheck.py`` / ``python -m tools.check`` and by
+hand::
+
+    python -m tools.concheck [--update-baseline] [--lockgraph] [paths...]
+
+Shares the analyzer plumbing in ``tools/analysis_core.py`` (one AST
+parse per file per process, ``# concheck: disable=CONxxx -- why``
+suppressions, content-keyed baseline — committed EMPTY).  The
+declarative contract lives in ``lock_registry.py`` (lock → guarded
+names; the permitted nesting DAG; the callback seams).  The RUNTIME
+half is the lock-order contract (``lightgbm_tpu/obs/lock_contract.py``,
+``LGBM_TPU_LOCK_CONTRACT=1``) and the interleaving fuzzer
+(``tools/interleave.py``); this package only analyzes source.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.analysis_core import (FileInfo, Finding, discover_files,
+                                 load_baseline, new_findings, suppressed,
+                                 write_baseline)
+
+from .rules import (FILE_RULES, PROJECT_RULES, RULE_TITLES, build_context,
+                    render_lockgraph)
+
+BASELINE_DEFAULT = os.path.join("tools", "concheck", "baseline.json")
+
+__all__ = [
+    "run_concheck", "Finding", "RULE_TITLES", "load_baseline",
+    "write_baseline", "new_findings", "BASELINE_DEFAULT",
+]
+
+
+def run_concheck(paths: Sequence[str] = ("lightgbm_tpu",),
+                 root: Optional[str] = None,
+                 project_rules: bool = True,
+                 ) -> Tuple[List[Finding], Dict[str, FileInfo]]:
+    """Analyze ``paths``; returns (findings sorted by location, FileInfo
+    by relative path).  Inline suppressions applied; the baseline is NOT
+    — callers diff via :func:`new_findings` (same contract as the other
+    four analyzers).  ``project_rules=False`` skips the registry-
+    soundness project rule for fixture runs."""
+    root = os.path.abspath(root or os.getcwd())
+    files = discover_files(paths, root)
+    ctx = build_context(files, root, project_rules=project_rules)
+    findings: List[Finding] = []
+    for fi in files:
+        for rule in FILE_RULES:
+            for f in rule(fi, ctx):
+                if not suppressed(fi, f):
+                    findings.append(f)
+    if project_rules:
+        for rule in PROJECT_RULES:
+            findings.extend(rule(ctx))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings, ctx.by_rel
+
+
+def render_graph(paths: Sequence[str] = ("lightgbm_tpu",),
+                 root: Optional[str] = None) -> str:
+    """The ``--lockgraph`` CLI view: registry + declared order DAG."""
+    root = os.path.abspath(root or os.getcwd())
+    files = discover_files(paths, root)
+    ctx = build_context(files, root, project_rules=False)
+    return render_lockgraph(ctx)

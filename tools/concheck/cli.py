@@ -1,0 +1,84 @@
+"""concheck CLI: ``python -m tools.concheck [options] [paths...]``.
+
+Exit codes mirror the other analyzers: 0 = clean vs baseline, 1 = new
+findings, 2 = usage error.  Output is ``file:line: RULE message``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import (BASELINE_DEFAULT, load_baseline, new_findings,
+               render_graph, run_concheck, write_baseline)
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.concheck",
+        description="thread & lock discipline analyzer for lightgbm_tpu "
+                    "(rules CON000-CON006; see README 'Static "
+                    "analysis')")
+    parser.add_argument("paths", nargs="*", default=["lightgbm_tpu"],
+                        help="files/directories to analyze "
+                             "(default: lightgbm_tpu)")
+    parser.add_argument("--root", default=None,
+                        help="project root (default: cwd)")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help=f"baseline file (default: {BASELINE_DEFAULT} "
+                             f"under --root)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, pinned or not")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to pin the current "
+                             "findings, then exit 0")
+    parser.add_argument("--no-project-rules", action="store_true",
+                        help="skip the registry-soundness project rule")
+    parser.add_argument("--lockgraph", action="store_true",
+                        help="dump the lock registry + declared order "
+                             "DAG, then exit 0")
+    args = parser.parse_args(argv)
+
+    root = os.path.abspath(args.root or os.getcwd())
+    baseline_path = (os.path.abspath(args.baseline) if args.baseline
+                     else os.path.join(root, BASELINE_DEFAULT))
+    try:
+        if args.lockgraph:
+            sys.stdout.write(render_graph(args.paths or ["lightgbm_tpu"],
+                                          root=root))
+            return 0
+        findings, by_rel = run_concheck(
+            args.paths or ["lightgbm_tpu"], root=root,
+            project_rules=not args.no_project_rules)
+    except OSError as exc:
+        print(f"concheck: {exc}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        write_baseline(baseline_path, findings, by_rel,
+                       tool="tools.concheck")
+        print(f"concheck: baseline updated with {len(findings)} "
+              f"finding(s) at {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    baseline = ({} if args.no_baseline
+                else load_baseline(baseline_path))
+    fresh = new_findings(findings, by_rel, baseline)
+    for f in fresh:
+        print(f.render())
+    pinned = len(findings) - len(fresh)
+    if fresh:
+        print(f"concheck: {len(fresh)} new finding(s)"
+              + (f" ({pinned} baselined)" if pinned else "")
+              + "; fix them, suppress with justification "
+                "(# concheck: disable=CONxxx -- why), or refresh the "
+                "baseline with --update-baseline")
+        return 1
+    print(f"concheck: clean ({pinned} baselined finding(s), "
+          f"{len(by_rel)} file(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

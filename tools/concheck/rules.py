@@ -1,0 +1,979 @@
+"""concheck rules: CON000-CON006 — thread & lock discipline, statically.
+
+The analyzer is name-based and declaration-driven, same philosophy as
+the other four walls (tpulint/spmdcheck/memcheck/detcheck): coarse
+resolution, a declarative registry as ground truth
+(``lock_registry.py``), and the rare over-taint handled by an inline
+``# concheck: disable=CONxxx -- why`` with its justification, never by
+a baseline entry.
+
+Machinery shared per run (one AST parse via ``tools/analysis_core``):
+
+* **Lock discovery** — structural (``X = threading.Lock()`` /
+  ``self._cv = Condition()`` / the ``named_lock`` contract wrappers)
+  merged with the central registry and in-file ``CONCHECK_*``
+  declarations.  A ``with <lock>:`` resolves through the owning
+  module + enclosing class.
+* **Thread reachability** — roots are functions passed as
+  ``Thread(target=...)`` plus the stdlib server callbacks that run on
+  connection threads (``handle``/``do_GET``/``do_POST``); propagation
+  rides the same name-based call-graph idea as
+  ``tools/tpulint/callgraph.py``.
+* **Lock closure** — which registered locks a call may acquire,
+  transitively, with a stop-list of names too generic to resolve
+  (``close``, ``run``, ...) pruned from *attribute* calls only; bare
+  and ``self.``-method calls always propagate.
+
+Rules:
+
+* **CON000** — registry inconsistency: a declared lock whose module or
+  attribute does not exist, an ORDER edge naming an unknown lock, or a
+  cyclic declared DAG.
+* **CON001** — a registered guarded name written from a
+  thread-reachable function without its lock held.
+* **CON002** — nested lock acquisition (lexical, or via a call's lock
+  closure) whose (outer, inner) pair has no path in the declared DAG;
+  re-entry is allowed for rlocks/conditions only.  Both acquisition
+  sites are named.
+* **CON003** — a blocking call (socket recv/accept, subprocess, jax
+  ``block_until_ready``, ``sleep``, or ``wait``/``join``/``result``
+  with no timeout) while a lock is held.  ``wait()`` on the held
+  condition itself is the one exemption — that's what conditions do.
+* **CON004** — a started ``threading.Thread`` with no reachable
+  stop/join path (non-daemon: exit-hang; daemon: leak).
+* **CON005** — a user-supplied callback/sink invoked under a held lock
+  without a declared-safe justification (the ``telemetry.set_sink``
+  re-entrancy seam).
+* **CON006** — check-then-act: a guarded flag read in an ``if`` test
+  outside its lock deciding a write to state of the same lock that is
+  also unlocked.
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis_core import FileInfo, Finding
+
+from . import lock_registry
+
+RULE_TITLES = {
+    "CON000": "lock registry inconsistency",
+    "CON001": "guarded state written without its lock",
+    "CON002": "lock nesting outside the declared DAG",
+    "CON003": "blocking call while holding a lock",
+    "CON004": "thread started without a stop/join path",
+    "CON005": "callback invoked under a held lock",
+    "CON006": "check-then-act on a guarded flag outside its lock",
+}
+
+# lock constructors recognized structurally (stdlib + the runtime
+# contract wrappers + the lazy factory utils modules use)
+_LOCK_CTORS = {
+    "Lock": "lock", "RLock": "rlock", "Condition": "condition",
+    "named_lock": "lock", "named_rlock": "rlock",
+    "named_condition": "condition",
+    "ContractLock": "lock", "ContractRLock": "rlock",
+    "ContractCondition": "condition",
+    "_named_lock": "lock", "_named_rlock": "rlock",
+    "_named_condition": "condition",
+}
+
+# stdlib socket-server / http-server callbacks that run on connection
+# threads: thread roots even though no Thread(target=...) names them
+_THREAD_ENTRY_NAMES = {"handle", "do_GET", "do_POST"}
+
+# attribute-call names too generic for name-based lock-closure
+# propagation (a `x.close()` must not drag every `close` method's
+# locks into the caller's nesting edges).  Bare-name and self-method
+# calls are never pruned.
+_NOISY_ATTR_CALLS = {
+    "close", "get", "put", "read", "write", "run", "start", "stop",
+    "join", "wait", "set", "clear", "update", "append", "pop", "add",
+    "send", "recv", "open", "flush", "shutdown", "release", "acquire",
+    "items", "values", "keys", "copy", "encode", "decode", "strip",
+    "split", "mark", "observe", "state", "reset",
+}
+
+_ALWAYS_BLOCKING = {"recv", "recvfrom", "recv_into", "accept", "select",
+                    "block_until_ready", "sleep"}
+_SUBPROCESS_CALLS = {"Popen", "check_call", "check_output", "call"}
+_TIMEOUT_BLOCKING = {"wait", "join", "result"}
+
+
+# ---------------------------------------------------------------------------
+# declarations
+# ---------------------------------------------------------------------------
+@dataclass
+class LockDecl:
+    name: str                       # registry-wide id
+    attr: str                       # variable holding the lock
+    cls: Optional[str]              # owning class (None = module level)
+    kind: str                       # lock | rlock | condition
+    guards: frozenset = frozenset()
+    assume_held: frozenset = frozenset()
+    declared: bool = False          # registry/in-file vs structural-only
+    line: int = 0                   # assignment site (structural)
+
+
+@dataclass
+class ConFunc:
+    fi: FileInfo
+    node: ast.AST
+    name: str
+    qual: str                       # "<rel>::dotted.path"
+    cls: Optional[str]              # innermost enclosing class
+    called_bare: Set[str] = field(default_factory=set)
+    called_attr: Set[str] = field(default_factory=set)
+
+    @property
+    def calls_for_reach(self) -> Set[str]:
+        return self.called_bare | self.called_attr
+
+    @property
+    def calls_for_locks(self) -> Set[str]:
+        return self.called_bare | (self.called_attr - _NOISY_ATTR_CALLS)
+
+
+@dataclass
+class ConContext:
+    root: str
+    files: List[FileInfo]
+    by_rel: Dict[str, FileInfo]
+    project_rules: bool
+    funcs: Dict[str, ConFunc] = field(default_factory=dict)
+    by_name: Dict[str, List[ConFunc]] = field(default_factory=dict)
+    thread_reachable: Set[str] = field(default_factory=set)
+    decls: Dict[str, List[LockDecl]] = field(default_factory=dict)
+    callbacks: Dict[str, Dict[str, Optional[str]]] = field(
+        default_factory=dict)
+    order_edges: Set[Tuple[str, str]] = field(default_factory=set)
+    order_reach: Dict[str, Set[str]] = field(default_factory=dict)
+    fn_locks: Dict[str, Set[str]] = field(default_factory=dict)
+    fn_locks_reach: Dict[str, Set[str]] = field(default_factory=dict)
+
+
+def _callee_name(func: ast.AST) -> Optional[str]:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+    return ".".join(reversed(parts))
+
+
+def _literal(node: ast.AST):
+    try:
+        return ast.literal_eval(node)
+    except (ValueError, SyntaxError, TypeError):
+        return None
+
+
+def _module_matches(rel: str, decl_module: str) -> bool:
+    return rel == decl_module or rel.endswith("/" + decl_module)
+
+
+# -- collection -----------------------------------------------------------
+def _collect_module(fi: FileInfo, ctx: ConContext) -> None:
+    """One walk: functions (with enclosing class), structural locks,
+    in-file declarations."""
+    structural: Dict[Tuple[Optional[str], str], Tuple[str, int]] = {}
+
+    def note_lock(cls: Optional[str], attr: str, value: ast.AST,
+                  line: int) -> None:
+        if not isinstance(value, ast.Call):
+            return
+        kind = _LOCK_CTORS.get(_callee_name(value.func) or "")
+        if kind is not None:
+            structural.setdefault((cls, attr), (kind, line))
+
+    def visit(node: ast.AST, cls: Optional[str], prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qual = f"{prefix}.{child.name}" if prefix else child.name
+                func = ConFunc(fi=fi, node=child, name=child.name,
+                               qual=f"{fi.rel}::{qual}", cls=cls)
+                for sub in ast.walk(child):
+                    if isinstance(sub, ast.Call):
+                        if isinstance(sub.func, ast.Name):
+                            func.called_bare.add(sub.func.id)
+                        elif isinstance(sub.func, ast.Attribute):
+                            base = sub.func.value
+                            if (isinstance(base, ast.Name)
+                                    and base.id in ("self", "cls")):
+                                func.called_bare.add(sub.func.attr)
+                            else:
+                                func.called_attr.add(sub.func.attr)
+                    elif isinstance(sub, ast.Assign):
+                        for tgt in sub.targets:
+                            if (isinstance(tgt, ast.Attribute)
+                                    and isinstance(tgt.value, ast.Name)
+                                    and tgt.value.id == "self"):
+                                note_lock(cls, tgt.attr, sub.value,
+                                          sub.lineno)
+                ctx.funcs[func.qual] = func
+                ctx.by_name.setdefault(func.name, []).append(func)
+                visit(child, cls, qual)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, child.name, prefix)
+            else:
+                if isinstance(child, ast.Assign) and cls is None \
+                        and not prefix:
+                    for tgt in child.targets:
+                        if isinstance(tgt, ast.Name):
+                            note_lock(None, tgt.id, child.value,
+                                      child.lineno)
+                visit(child, cls, prefix)
+
+    visit(fi.tree, None, "")
+
+    # merge: central registry > in-file CONCHECK_* > structural
+    decls: Dict[Tuple[Optional[str], str], LockDecl] = {}
+    for (cls, attr), (kind, line) in structural.items():
+        decls[(cls, attr)] = LockDecl(
+            name=f"{fi.basename}:{attr}", attr=attr, cls=cls, kind=kind,
+            line=line)
+
+    infile = _infile_decls(fi)
+    for (cls, attr), (guards, assume) in infile["locks"].items():
+        d = decls.get((cls, attr))
+        name = f"{fi.basename}:{attr}"
+        if d is None:
+            d = decls[(cls, attr)] = LockDecl(
+                name=name, attr=attr, cls=cls, kind="lock")
+        d.name = name
+        d.guards = frozenset(guards)
+        d.assume_held = frozenset(assume)
+        d.declared = True
+    for outer, inner in infile["order"]:
+        ctx.order_edges.add((f"{fi.basename}:{outer}",
+                             f"{fi.basename}:{inner}"))
+    if infile["callbacks"]:
+        ctx.callbacks.setdefault(fi.rel, {}).update(infile["callbacks"])
+
+    for entry in lock_registry.LOCKS:
+        if not _module_matches(fi.rel, entry["module"]):
+            continue
+        key = (entry.get("cls"), entry["attr"])
+        d = decls.get(key)
+        if d is None:
+            d = decls[key] = LockDecl(
+                name=entry["name"], attr=entry["attr"],
+                cls=entry.get("cls"), kind=entry.get("kind", "lock"))
+        d.name = entry["name"]
+        d.kind = entry.get("kind", d.kind)
+        d.guards = frozenset(entry.get("guards", ()))
+        d.assume_held = frozenset(entry.get("assume_held", ()))
+        d.declared = True
+    for entry in lock_registry.CALLBACKS:
+        if _module_matches(fi.rel, entry["module"]):
+            ctx.callbacks.setdefault(fi.rel, {})[entry["name"]] = \
+                entry.get("safe")
+
+    ctx.decls[fi.rel] = list(decls.values())
+
+
+def _infile_decls(fi: FileInfo) -> Dict:
+    out = {"locks": {}, "order": [], "assume": set(), "callbacks": {}}
+    for node in fi.tree.body:
+        if not isinstance(node, ast.Assign) or len(node.targets) != 1 \
+                or not isinstance(node.targets[0], ast.Name):
+            continue
+        tgt = node.targets[0].id
+        val = _literal(node.value)
+        if val is None:
+            continue
+        if tgt == "CONCHECK_LOCKS" and isinstance(val, dict):
+            for key, guards in val.items():
+                cls, _, attr = str(key).rpartition(".")
+                out["locks"][(cls or None, attr)] = (
+                    tuple(guards), ())
+        elif tgt == "CONCHECK_ORDER":
+            out["order"] = [tuple(p) for p in val if len(tuple(p)) == 2]
+        elif tgt == "CONCHECK_ASSUME_HELD":
+            out["assume"] = set(val)
+        elif tgt == "CONCHECK_CALLBACKS":
+            if isinstance(val, dict):
+                out["callbacks"] = {str(k): v for k, v in val.items()}
+            else:
+                out["callbacks"] = {str(v): None for v in val}
+    if out["assume"]:
+        out["locks"] = {
+            k: (guards, tuple(out["assume"]))
+            for k, (guards, _) in out["locks"].items()}
+    return out
+
+
+# -- resolution helpers ---------------------------------------------------
+def _resolve_lock(ctx: ConContext, fi: FileInfo, cls: Optional[str],
+                  expr: ast.AST) -> Optional[LockDecl]:
+    """The LockDecl a ``with <expr>:`` / ``<expr>.wait()`` refers to."""
+    decls = ctx.decls.get(fi.rel, ())
+    if isinstance(expr, ast.Name):
+        for d in decls:
+            if d.cls is None and d.attr == expr.id:
+                return d
+        return None
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        if expr.value.id in ("self", "cls"):
+            best = None
+            for d in decls:
+                if d.attr != expr.attr:
+                    continue
+                if d.cls == cls:
+                    return d
+                if best is None:
+                    best = d
+            return best
+        # `other._lock`: resolvable only when the attr is unambiguous
+        cands = [d for d in decls if d.attr == expr.attr]
+        return cands[0] if len(cands) == 1 else None
+    return None
+
+
+def _guard_decl(ctx: ConContext, fi: FileInfo, cls: Optional[str],
+                name: str, is_self_attr: bool) -> Optional[LockDecl]:
+    """The decl (if any) whose guards contain ``name``."""
+    for d in ctx.decls.get(fi.rel, ()):
+        if name not in d.guards:
+            continue
+        if is_self_attr:
+            if d.cls is not None and (cls is None or d.cls == cls):
+                return d
+            if d.cls is None:
+                # a module-global mutated through an alias is rare;
+                # self-attrs prefer class-scoped decls
+                continue
+        else:
+            if d.cls is None:
+                return d
+    return None
+
+
+def _write_targets(node: ast.AST) -> List[ast.AST]:
+    if isinstance(node, ast.Assign):
+        return list(node.targets)
+    if isinstance(node, (ast.AugAssign, ast.AnnAssign)):
+        return [node.target]
+    if isinstance(node, ast.Delete):
+        return list(node.targets)
+    return []
+
+
+def _base_written_name(tgt: ast.AST) -> Optional[Tuple[str, bool]]:
+    """(name, is_self_attr) for the storage a write target mutates:
+    ``x`` / ``x[k]`` -> ("x", False); ``self.y`` / ``self.y[k]`` ->
+    ("y", True).  Tuple targets recurse in the caller."""
+    while isinstance(tgt, ast.Subscript):
+        tgt = tgt.value
+    if isinstance(tgt, ast.Name):
+        return tgt.id, False
+    if isinstance(tgt, ast.Attribute) and isinstance(tgt.value, ast.Name) \
+            and tgt.value.id in ("self", "cls"):
+        return tgt.attr, True
+    return None
+
+
+def _order_closure(edges: Set[Tuple[str, str]]) -> Dict[str, Set[str]]:
+    adj: Dict[str, Set[str]] = {}
+    for a, b in edges:
+        adj.setdefault(a, set()).add(b)
+    reach: Dict[str, Set[str]] = {}
+    for start in adj:
+        seen: Set[str] = set()
+        work = list(adj.get(start, ()))
+        while work:
+            n = work.pop()
+            if n in seen:
+                continue
+            seen.add(n)
+            work.extend(adj.get(n, ()))
+        reach[start] = seen
+    return reach
+
+
+# -- context --------------------------------------------------------------
+def build_context(files: Sequence[FileInfo], root: str,
+                  project_rules: bool) -> ConContext:
+    ctx = ConContext(root=root, files=list(files),
+                     by_rel={fi.rel: fi for fi in files},
+                     project_rules=project_rules)
+    for a, b in lock_registry.ORDER:
+        ctx.order_edges.add((a, b))
+    for fi in files:
+        _collect_module(fi, ctx)
+    ctx.order_reach = _order_closure(ctx.order_edges)
+
+    # thread roots: Thread(target=...) / Timer(..., f) + server entries
+    root_names: Set[str] = set(_THREAD_ENTRY_NAMES)
+    for fi in files:
+        for node in ast.walk(fi.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            if _callee_name(node.func) not in ("Thread", "Timer"):
+                continue
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    name = _callee_name(kw.value)
+                    if name:
+                        root_names.add(name)
+    work = [f.qual for f in ctx.funcs.values() if f.name in root_names]
+    while work:
+        q = work.pop()
+        if q in ctx.thread_reachable:
+            continue
+        ctx.thread_reachable.add(q)
+        for callee in ctx.funcs[q].calls_for_reach:
+            for f in ctx.by_name.get(callee, ()):
+                if f.qual not in ctx.thread_reachable:
+                    work.append(f.qual)
+
+    # per-function directly-acquired locks, then the transitive closure
+    for func in ctx.funcs.values():
+        acquired: Set[str] = set()
+        for sub in ast.walk(func.node):
+            if isinstance(sub, ast.With):
+                for item in sub.items:
+                    d = _resolve_lock(ctx, func.fi, func.cls,
+                                      item.context_expr)
+                    if d is not None:
+                        acquired.add(d.name)
+        ctx.fn_locks[func.qual] = acquired
+    for func in ctx.funcs.values():
+        seen: Set[str] = set(ctx.fn_locks[func.qual])
+        visited = {func.qual}
+        work = [c for c in func.calls_for_locks]
+        while work:
+            callee = work.pop()
+            for f in ctx.by_name.get(callee, ()):
+                if f.qual in visited:
+                    continue
+                visited.add(f.qual)
+                seen |= ctx.fn_locks[f.qual]
+                work.extend(f.calls_for_locks)
+        ctx.fn_locks_reach[func.qual] = seen
+    return ctx
+
+
+# ---------------------------------------------------------------------------
+# per-function walk: CON001/CON002/CON003/CON005/CON006
+# ---------------------------------------------------------------------------
+def _edge_ok(ctx: ConContext, outer: LockDecl, inner_name: str,
+             inner_kind: Optional[str]) -> bool:
+    if outer.name == inner_name:
+        return (inner_kind or "lock") in ("rlock", "condition")
+    if (outer.name, inner_name) in ctx.order_edges:
+        return True
+    return inner_name in ctx.order_reach.get(outer.name, ())
+
+
+def _is_blocking(call: ast.Call, held: List[Tuple[LockDecl, int]],
+                 ctx: ConContext, fi: FileInfo, cls: Optional[str]
+                 ) -> Optional[str]:
+    name = _callee_name(call.func)
+    if name is None:
+        return None
+    dotted = _dotted(call.func) if isinstance(call.func,
+                                              ast.Attribute) else name
+    if name in _ALWAYS_BLOCKING:
+        return f"{dotted}()"
+    if name in _SUBPROCESS_CALLS or dotted.startswith("subprocess."):
+        return f"{dotted}()"
+    if name in _TIMEOUT_BLOCKING:
+        has_timeout = bool(call.args) or any(
+            kw.arg == "timeout" for kw in call.keywords)
+        if has_timeout:
+            return None
+        # `held_cv.wait()` releases the held condition: exempt
+        if name == "wait" and isinstance(call.func, ast.Attribute):
+            d = _resolve_lock(ctx, fi, cls, call.func.value)
+            if d is not None and held and d.name == held[-1][0].name \
+                    and d.kind == "condition":
+                return None
+        return f"{dotted}() with no timeout"
+    return None
+
+
+def _scan_function(func: ConFunc, ctx: ConContext,
+                   out: List[Finding]) -> None:
+    fi = func.fi
+    reachable = func.qual in ctx.thread_reachable
+    cbmap = ctx.callbacks.get(fi.rel, {})
+    reported_edges: Set[Tuple[str, str, str]] = set()
+
+    def check_write(node: ast.AST, held: List[Tuple[LockDecl, int]],
+                    quiet: bool = False) -> Optional[LockDecl]:
+        hit = None
+        for tgt in _write_targets(node):
+            tgts = tgt.elts if isinstance(tgt, (ast.Tuple, ast.List)) \
+                else [tgt]
+            for t in tgts:
+                based = _base_written_name(t)
+                if based is None:
+                    continue
+                name, is_self = based
+                d = _guard_decl(ctx, fi, func.cls, name, is_self)
+                if d is None:
+                    continue
+                hit = d
+                if quiet:
+                    continue
+                if any(h.name == d.name for h, _ in held):
+                    continue
+                if func.name in d.assume_held or func.name == "__init__":
+                    continue
+                if not reachable:
+                    continue
+                out.append(Finding(
+                    fi.rel, node.lineno, "CON001",
+                    f"'{name}' is registered as guarded by lock "
+                    f"'{d.name}' but is written here without it; this "
+                    f"function is reachable from a thread entry point. "
+                    f"Hold the lock, or move the name out of the "
+                    f"registry entry with a why."))
+        return hit
+
+    def check_call(call: ast.Call,
+                   held: List[Tuple[LockDecl, int]]) -> None:
+        if not held:
+            return
+        outer, outer_line = held[-1]
+        blocking = _is_blocking(call, held, ctx, fi, func.cls)
+        if blocking is not None:
+            out.append(Finding(
+                fi.rel, call.lineno, "CON003",
+                f"blocking call {blocking} while holding lock "
+                f"'{outer.name}' (acquired line {outer_line}): every "
+                f"other acquirer stalls behind this wait.  Move the "
+                f"call outside the critical section or bound it with "
+                f"a timeout."))
+        # CON005: user-supplied callback under a held lock
+        cb_name = None
+        if isinstance(call.func, ast.Name) and call.func.id in cbmap:
+            cb_name = call.func.id
+        elif isinstance(call.func, ast.Attribute):
+            base = call.func.value
+            if isinstance(base, ast.Name) and base.id in cbmap:
+                cb_name = base.id
+            elif isinstance(base, ast.Name) and base.id in ("self", "cls") \
+                    and call.func.attr in cbmap:
+                cb_name = call.func.attr
+            if call.func.attr in cbmap and cb_name is None \
+                    and isinstance(base, ast.Name):
+                cb_name = call.func.attr
+        if cb_name is not None and cbmap.get(cb_name) is None:
+            out.append(Finding(
+                fi.rel, call.lineno, "CON005",
+                f"callback '{cb_name}' invoked while holding lock "
+                f"'{outer.name}' (acquired line {outer_line}): a "
+                f"callback that re-enters this module re-acquires the "
+                f"lock and deadlocks (rlock) or self-deadlocks (lock). "
+                f"Invoke it outside the lock, or declare it safe in "
+                f"the registry with a leaf-lock argument."))
+        # CON002 via the callee's transitive lock set
+        callee = _callee_name(call.func)
+        if callee is None:
+            return
+        attr_style = isinstance(call.func, ast.Attribute) and not (
+            isinstance(call.func.value, ast.Name)
+            and call.func.value.id in ("self", "cls"))
+        if attr_style and callee in _NOISY_ATTR_CALLS:
+            return
+        inner: Set[str] = set()
+        kinds: Dict[str, str] = {}
+        for f in ctx.by_name.get(callee, ()):
+            inner |= ctx.fn_locks_reach.get(f.qual, set())
+        for rel_decls in ctx.decls.values():
+            for d in rel_decls:
+                kinds.setdefault(d.name, d.kind)
+        for lock_name in sorted(inner):
+            if _edge_ok(ctx, outer, lock_name, kinds.get(lock_name)):
+                continue
+            key = (outer.name, lock_name, callee)
+            if key in reported_edges:
+                continue
+            reported_edges.add(key)
+            out.append(Finding(
+                fi.rel, call.lineno, "CON002",
+                f"call to {callee}() may acquire lock '{lock_name}' "
+                f"while holding '{outer.name}' (acquired line "
+                f"{outer_line}), an edge absent from the declared "
+                f"lock-order DAG — a concurrent acquirer in the "
+                f"opposite order deadlocks.  Declare the edge in "
+                f"lock_registry.ORDER or move the call outside the "
+                f"lock."))
+
+    def check_if(node: ast.If, held: List[Tuple[LockDecl, int]]) -> None:
+        held_names = {h.name for h, _ in held}
+        read: Optional[Tuple[str, LockDecl]] = None
+        for sub in ast.walk(node.test):
+            based = None
+            if isinstance(sub, ast.Name):
+                based = (sub.id, False)
+            elif isinstance(sub, ast.Attribute) \
+                    and isinstance(sub.value, ast.Name) \
+                    and sub.value.id in ("self", "cls"):
+                based = (sub.attr, True)
+            if based is None:
+                continue
+            d = _guard_decl(ctx, fi, func.cls, based[0], based[1])
+            if d is not None and d.name not in held_names:
+                read = (based[0], d)
+                break
+        if read is None:
+            return
+        flag, d = read
+        if func.name in d.assume_held or func.name == "__init__":
+            return
+        # an unlocked write to the same lock's state anywhere in the
+        # If body/orelse (a write under the lock is double-checked
+        # locking, which is fine — the decision is re-validated)
+        for body in (node.body, node.orelse):
+            for stmt in body:
+                for sub in ast.walk(stmt):
+                    if isinstance(sub, (ast.FunctionDef,
+                                        ast.AsyncFunctionDef,
+                                        ast.ClassDef, ast.With)):
+                        continue
+                    if not _write_targets(sub):
+                        continue
+                    # skip writes nested under a With on d's lock
+                    if _under_lock_with(stmt, sub, ctx, fi, func.cls,
+                                        d.name):
+                        continue
+                    for tgt in _write_targets(sub):
+                        based = _base_written_name(tgt)
+                        if based is None:
+                            continue
+                        dd = _guard_decl(ctx, fi, func.cls, based[0],
+                                         based[1])
+                        if dd is not None and dd.name == d.name:
+                            out.append(Finding(
+                                fi.rel, node.lineno, "CON006",
+                                f"check-then-act: '{flag}' (guarded by "
+                                f"lock '{d.name}') is tested here "
+                                f"without the lock and '{based[0]}' is "
+                                f"then written at line {sub.lineno}, "
+                                f"also unlocked — two threads can both "
+                                f"pass the test.  Take the lock around "
+                                f"the test AND the act."))
+                            return
+
+    def walk(stmts: Sequence[ast.AST],
+             held: List[Tuple[LockDecl, int]]) -> None:
+        for node in stmts:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.ClassDef)):
+                continue                # separate ConFunc / class scope
+            if isinstance(node, ast.With):
+                acquired: List[LockDecl] = []
+                for item in node.items:
+                    d = _resolve_lock(ctx, fi, func.cls,
+                                      item.context_expr)
+                    if d is None:
+                        continue
+                    if held:
+                        outer, outer_line = held[-1]
+                        if not _edge_ok(ctx, outer, d.name, d.kind):
+                            key = (outer.name, d.name, "")
+                            if key not in reported_edges:
+                                reported_edges.add(key)
+                                out.append(Finding(
+                                    fi.rel, node.lineno, "CON002",
+                                    f"lock '{d.name}' acquired here "
+                                    f"while holding '{outer.name}' "
+                                    f"(acquired line {outer_line}): "
+                                    f"this nesting edge is absent "
+                                    f"from the declared lock-order "
+                                    f"DAG — the reverse order "
+                                    f"elsewhere deadlocks.  Declare "
+                                    f"it in lock_registry.ORDER or "
+                                    f"restructure."))
+                    held.append((d, node.lineno))
+                    acquired.append(d)
+                walk(node.body, held)
+                for _ in acquired:
+                    held.pop()
+                continue
+            if isinstance(node, ast.If):
+                check_if(node, held)
+            check_write(node, held)
+            for sub in ast.iter_child_nodes(node):
+                if isinstance(sub, (ast.stmt,)):
+                    continue            # handled by the stmt recursion
+                for call in ast.walk(sub):
+                    if isinstance(call, ast.Call):
+                        check_call(call, held)
+            body_attrs = [getattr(node, f, []) for f in
+                          ("body", "orelse", "finalbody", "handlers")]
+            for blk in body_attrs:
+                if blk and isinstance(blk[0], ast.ExceptHandler):
+                    for h in blk:
+                        walk(h.body, held)
+                elif blk:
+                    walk(blk, held)
+
+    body = getattr(func.node, "body", [])
+    walk(body, [])
+
+
+def _under_lock_with(top: ast.AST, target: ast.AST, ctx: ConContext,
+                     fi: FileInfo, cls: Optional[str],
+                     lock_name: str) -> bool:
+    """True when ``target`` sits under a ``with <lock_name>`` inside
+    ``top`` (double-checked locking recognition for CON006)."""
+    found = False
+
+    def visit(node: ast.AST, locked: bool) -> None:
+        nonlocal found
+        if node is target and locked:
+            found = True
+            return
+        now = locked
+        if isinstance(node, ast.With):
+            for item in node.items:
+                d = _resolve_lock(ctx, fi, cls, item.context_expr)
+                if d is not None and d.name == lock_name:
+                    now = True
+        for child in ast.iter_child_nodes(node):
+            visit(child, now)
+
+    visit(top, False)
+    return found
+
+
+# ---------------------------------------------------------------------------
+# CON004: thread lifecycle (module-wide)
+# ---------------------------------------------------------------------------
+def rule_thread_lifecycle(fi: FileInfo, ctx: ConContext) -> List[Finding]:
+    out: List[Finding] = []
+    join_bases: Set[str] = set()
+    start_bases: Set[str] = set()
+    joined_containers: Set[str] = set()
+    containers: Dict[str, Set[str]] = {}    # container attr -> member names
+    thread_calls: List[Tuple[ast.Call, ast.AST]] = []   # (ctor, parent)
+    # enclosing `for <var> in <container>` frames, innermost last
+    for_stack: List[Tuple[str, str]] = []
+
+    # ONE parent-tracking traversal gathers everything the verdict pass
+    # needs (the naive shape — a parents map plus a full ast.walk per
+    # fact plus a nested walk per For — dominated the whole analyzer)
+    def scan(node: ast.AST, parent: Optional[ast.AST]) -> None:
+        if isinstance(node, ast.Call):
+            if isinstance(node.func, ast.Attribute):
+                base = node.func.value
+                base_name = None
+                if isinstance(base, ast.Name):
+                    base_name = base.id
+                elif isinstance(base, ast.Attribute):
+                    base_name = base.attr
+                if base_name:
+                    if node.func.attr == "join":
+                        join_bases.add(base_name)
+                        if isinstance(base, ast.Name):
+                            for v, it_name in for_stack:
+                                if v == base.id:
+                                    joined_containers.add(it_name)
+                    elif node.func.attr == "start":
+                        start_bases.add(base_name)
+                    elif node.func.attr == "append" and node.args:
+                        member = node.args[0]
+                        if isinstance(member, ast.Name):
+                            containers.setdefault(base_name, set()).add(
+                                member.id)
+            if _callee_name(node.func) in ("Thread", "Timer"):
+                thread_calls.append((node, parent))
+        elif isinstance(node, ast.Assign) \
+                and isinstance(node.value, (ast.List, ast.Tuple)):
+            names = {e.id for e in node.value.elts
+                     if isinstance(e, ast.Name)}
+            if names:
+                for tgt in node.targets:
+                    based = _base_written_name(tgt)
+                    if based is not None:
+                        containers.setdefault(based[0], set()).update(
+                            names)
+        pushed = False
+        if isinstance(node, ast.For) and isinstance(node.target,
+                                                    ast.Name):
+            it = node.iter
+            it_name = None
+            if isinstance(it, ast.Name):
+                it_name = it.id
+            elif isinstance(it, ast.Attribute):
+                it_name = it.attr
+            if it_name:
+                for_stack.append((node.target.id, it_name))
+                pushed = True
+        for child in ast.iter_child_nodes(node):
+            scan(child, node)
+        if pushed:
+            for_stack.pop()
+
+    scan(fi.tree, None)
+
+    def joined(binding: str) -> bool:
+        if binding in join_bases:
+            return True
+        for cont, members in containers.items():
+            if binding in members and cont in joined_containers:
+                return True
+        return False
+
+    for node, parent in thread_calls:
+        daemon = False
+        for kw in node.keywords:
+            if kw.arg == "daemon" and isinstance(kw.value, ast.Constant):
+                daemon = bool(kw.value.value)
+        binding: Optional[str] = None
+        inline_start = (isinstance(parent, ast.Attribute)
+                        and parent.attr == "start")
+        if isinstance(parent, ast.Assign):
+            based = _base_written_name(parent.targets[0])
+            if based is not None:
+                binding = based[0]
+        why = ("daemon leak" if daemon
+               else "a non-daemon thread with no join path delays "
+                    "interpreter exit indefinitely")
+        if inline_start:
+            out.append(Finding(
+                fi.rel, node.lineno, "CON004",
+                f"Thread started inline with no handle: nothing can "
+                f"ever stop or join it ({why}).  Keep the handle and "
+                f"give it a stop + join(timeout) path."))
+            continue
+        if binding is None:
+            continue                    # passed straight somewhere: rare,
+            #                             the container rules can't see it
+        if binding not in start_bases:
+            continue                    # never started
+        if joined(binding):
+            continue
+        why2 = ("a daemon with no stop/join path leaks until process "
+                "exit" if daemon
+                else "a non-daemon thread with no join path hangs "
+                     "interpreter exit")
+        out.append(Finding(
+            fi.rel, node.lineno, "CON004",
+            f"thread bound to '{binding}' is started but no join path "
+            f"exists in this module ({why2}).  Add a stop + "
+            f"join(timeout) path (the bounded-shutdown contract)."))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# file rules
+# ---------------------------------------------------------------------------
+def rule_function_walks(fi: FileInfo, ctx: ConContext) -> List[Finding]:
+    out: List[Finding] = []
+    for func in ctx.funcs.values():
+        if func.fi.rel == fi.rel:
+            _scan_function(func, ctx, out)
+    return out
+
+
+FILE_RULES = (rule_function_walks, rule_thread_lifecycle)
+
+
+# ---------------------------------------------------------------------------
+# project rules: CON000 registry soundness
+# ---------------------------------------------------------------------------
+def rule_registry_sound(ctx: ConContext) -> List[Finding]:
+    out: List[Finding] = []
+    names: Set[str] = set()
+    for entry in lock_registry.LOCKS:
+        name = entry["name"]
+        if name in names:
+            out.append(Finding(
+                entry["module"], 1, "CON000",
+                f"duplicate lock name '{name}' in lock_registry.LOCKS"))
+        names.add(name)
+        matches = [fi for fi in ctx.files
+                   if _module_matches(fi.rel, entry["module"])]
+        if not matches:
+            out.append(Finding(
+                entry["module"], 1, "CON000",
+                f"lock '{name}' declares module '{entry['module']}' "
+                f"which is not among the analyzed files"))
+            continue
+        fi = matches[0]
+        found = any(
+            d.attr == entry["attr"] and d.cls == entry.get("cls")
+            and d.line
+            for d in ctx.decls.get(fi.rel, ()))
+        if not found:
+            out.append(Finding(
+                fi.rel, 1, "CON000",
+                f"lock '{name}' declares attribute "
+                f"'{entry.get('cls') or '<module>'}.{entry['attr']}' "
+                f"but no lock construction for it was found"))
+    for a, b in lock_registry.ORDER:
+        for n in (a, b):
+            if n not in names:
+                out.append(Finding(
+                    "tools/concheck/lock_registry.py", 1, "CON000",
+                    f"ORDER edge ({a!r}, {b!r}) references unknown "
+                    f"lock '{n}'"))
+    # the declared DAG must actually be a DAG
+    adj: Dict[str, Set[str]] = {}
+    for a, b in lock_registry.ORDER:
+        adj.setdefault(a, set()).add(b)
+    state: Dict[str, int] = {}
+
+    def cyclic(n: str, path: List[str]) -> Optional[List[str]]:
+        state[n] = 1
+        for m in adj.get(n, ()):
+            if state.get(m, 0) == 1:
+                return path + [n, m]
+            if state.get(m, 0) == 0:
+                got = cyclic(m, path + [n])
+                if got:
+                    return got
+        state[n] = 2
+        return None
+
+    for n in list(adj):
+        if state.get(n, 0) == 0:
+            cycle = cyclic(n, [])
+            if cycle:
+                out.append(Finding(
+                    "tools/concheck/lock_registry.py", 1, "CON000",
+                    f"declared lock-order DAG contains a cycle: "
+                    f"{' -> '.join(cycle)}"))
+                break
+    return out
+
+
+PROJECT_RULES = (rule_registry_sound,)
+
+
+# ---------------------------------------------------------------------------
+# the lock-graph view (CLI --lockgraph)
+# ---------------------------------------------------------------------------
+def render_lockgraph(ctx: ConContext) -> str:
+    lines: List[str] = ["# concheck lock registry", ""]
+    for entry in lock_registry.LOCKS:
+        owner = entry.get("cls") or "<module>"
+        lines.append(f"{entry['name']:18s} {entry.get('kind', 'lock'):10s} "
+                     f"{entry['module']} {owner}.{entry['attr']}")
+        guards = ", ".join(entry.get("guards", ())) or "-"
+        lines.append(f"{'':18s} guards: {guards}")
+    lines.append("")
+    lines.append("# declared order (outer -> inner)")
+    for a, b in lock_registry.ORDER:
+        lines.append(f"{a} -> {b}")
+    threads = sorted(q for q in ctx.thread_reachable)
+    lines.append("")
+    lines.append(f"# thread-reachable functions: {len(threads)}")
+    return "\n".join(lines) + "\n"

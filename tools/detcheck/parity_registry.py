@@ -245,6 +245,17 @@ EXEMPT_ENV: Dict[str, str] = {
     "LGBM_TPU_COLLECTIVE_SLOW": "fault-injection straggler delay "
                                 "(collective.slow); a sleep before the "
                                 "collective, identity-neutral",
+    "LGBM_TPU_LOCK_CONTRACT": "observability: runtime lock-order "
+                              "contract (obs/lock_contract.py) — "
+                              "wrapped host locks record acquisition "
+                              "order and wait/hold timing, never "
+                              "touching what the device computes",
+    "LGBM_TPU_LOCK_HOLD_S": "observability: held-past-deadline "
+                            "threshold for contract-named locks; "
+                            "reporting knob only",
+    "LGBM_TPU_INTERLEAVE_SEEDS": "test harness: seed count for the "
+                                 "tools/interleave.py schedule fuzzer; "
+                                 "never read by library code",
 }
 
 # -- DET004: first-max tie-break contracts -------------------------------

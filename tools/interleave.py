@@ -1,0 +1,426 @@
+"""Interleaving fuzzer for the package's thread seams.
+
+``tools/concheck`` proves lock discipline statically and
+``obs/lock_contract.py`` watches it at runtime; this tool makes the
+schedules that break undisciplined code actually HAPPEN.  For each seed
+it randomizes the interpreter's thread switch interval
+(``sys.setswitchinterval``) — forcing preemption at bytecode boundaries
+a quiet machine never exercises — and drives the four seams where this
+codebase's threads genuinely contend:
+
+* ``coord``    — elastic coordinator membership churn: clients join,
+  leave, and get fault-evicted (``rendezvous.drop_rank``) from
+  concurrent socket threads while the membership view is sampled.
+  Invariants: the generation counter never moves backwards, every
+  sampled rank map is contiguous ``0..W-1`` in sorted member-id order
+  (the deterministic-rank law), and a fully-drained world ends at
+  ``world == 0``.
+* ``server``   — ``PredictionServer`` submit vs. close: submitters race
+  a closer.  Invariants: every admitted future resolves (exactly-once
+  delivery — a stranded future means a request fell between the
+  ``_closed`` check and the drain), results are correct, and
+  ``submitted == resolved + failed`` with the worker thread dead.
+* ``watchdog`` — ``Watchdog`` arm/disarm churn vs. the monitor.
+  Invariants: a span disarmed before its deadline never fires, an
+  abandoned arm always fires, and ``stop()`` really stops the monitor.
+* ``ledger``   — ``FleetLedger`` concurrent ``put_line``/``close``.
+  Invariants: the file holds exactly the lines written, every line is
+  whole and parseable, and writes racing ``close`` are dropped, not
+  torn.
+
+The runtime lock contract is armed for the run (the seams construct
+their locks after import, so wrappers engage): any contract violation —
+acquisition-order cycle, unguarded access, held-past-deadline — fails
+the fuzz like a seam invariant would.
+
+Usage::
+
+    python -m tools.interleave [--seeds N] [--seams coord,server,...]
+
+``--seeds`` defaults to ``LGBM_TPU_INTERLEAVE_SEEDS`` (else 3).  Exit
+0 = every seed clean, 1 = an invariant or contract violation (printed
+with its seed, seam, and detail), 2 = usage error.  The tier-1 gate
+(``tests/test_lock_contract.py``) runs a toy shape; CI soaks raise the
+seed count.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import sys
+import tempfile
+import threading
+import time
+from typing import Callable, Dict, List
+
+# arm the runtime contract before the library modules create their
+# locks: enabled() is read at lock construction
+os.environ.setdefault("LGBM_TPU_LOCK_CONTRACT", "1")
+
+_SWITCH_INTERVALS = (1e-6, 5e-6, 2e-5, 1e-4, 1e-3)
+
+
+def _join_all(threads: List[threading.Thread], what: str,
+              viol: List[str], timeout: float = 30.0) -> None:
+    deadline = time.monotonic() + timeout
+    for t in threads:
+        t.join(max(deadline - time.monotonic(), 0.1))
+        if t.is_alive():
+            viol.append(f"{what}: thread {t.name} still alive after "
+                        f"{timeout:.0f}s — a wedged schedule")
+
+
+# ---------------------------------------------------------------------------
+# seam: fleet ledger
+# ---------------------------------------------------------------------------
+def seam_ledger(rng: random.Random, tmp: str) -> List[str]:
+    from lightgbm_tpu.obs import fleet
+    viol: List[str] = []
+    nthreads, per = 4, 20
+
+    # phase 1: pure concurrent appends — every line lands, whole
+    path = os.path.join(tmp, f"ledger-{rng.randrange(1 << 30)}.jsonl")
+    led = fleet.FleetLedger(path)
+
+    def writer(tid: int, seed: int) -> None:
+        r = random.Random(seed)
+        for i in range(per):
+            led.put_line("fuzz", tid=tid, i=i)
+            if r.random() < 0.2:
+                time.sleep(0)
+
+    ts = [threading.Thread(target=writer, args=(k, rng.randrange(1 << 30)),
+                           name=f"ledger-w{k}") for k in range(nthreads)]
+    for t in ts:
+        t.start()
+    _join_all(ts, "ledger", viol)
+    led.close()
+    seen = set()
+    with open(path, "r", encoding="utf-8") as f:
+        for ln, line in enumerate(f, 1):
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                viol.append(f"ledger: torn/unparseable line {ln}: "
+                            f"{line[:80]!r}")
+                continue
+            seen.add((rec.get("tid"), rec.get("i")))
+    want = {(k, i) for k in range(nthreads) for i in range(per)}
+    if seen != want:
+        viol.append(f"ledger: {len(want - seen)} line(s) lost, "
+                    f"{len(seen - want)} unexpected (of {len(want)})")
+
+    # phase 2: writes racing close — dropped whole, never torn
+    path2 = os.path.join(tmp, f"ledger2-{rng.randrange(1 << 30)}.jsonl")
+    led2 = fleet.FleetLedger(path2)
+
+    def racer(seed: int) -> None:
+        r = random.Random(seed)
+        for i in range(per):
+            led2.put_line("race", i=i)
+            if r.random() < 0.3:
+                time.sleep(0)
+
+    ts2 = [threading.Thread(target=racer, args=(rng.randrange(1 << 30),),
+                            name=f"ledger-r{k}") for k in range(2)]
+    for t in ts2:
+        t.start()
+    time.sleep(rng.uniform(0.0, 0.01))
+    led2.close()
+    _join_all(ts2, "ledger", viol)
+    with open(path2, "r", encoding="utf-8") as f:
+        for ln, line in enumerate(f, 1):
+            try:
+                json.loads(line)
+            except ValueError:
+                viol.append(f"ledger: line {ln} torn by a racing "
+                            f"close: {line[:80]!r}")
+    return viol
+
+
+# ---------------------------------------------------------------------------
+# seam: stall watchdog
+# ---------------------------------------------------------------------------
+def seam_watchdog(rng: random.Random, tmp: str) -> List[str]:
+    from lightgbm_tpu.obs import health
+    viol: List[str] = []
+    old_forensic = os.environ.get("LGBM_TPU_FORENSIC")
+    os.environ["LGBM_TPU_FORENSIC"] = os.path.join(tmp, "forensic.json")
+    try:
+        # phase 1: disarm always beats a generous deadline — no fire
+        wd = health.Watchdog("fuzz", deadline_s=30.0)
+
+        def churn(seed: int) -> None:
+            r = random.Random(seed)
+            for i in range(20):
+                wd.arm(f"span-{i}")
+                if r.random() < 0.5:
+                    time.sleep(0)
+                wd.disarm()
+
+        ts = [threading.Thread(target=churn,
+                               args=(rng.randrange(1 << 30),),
+                               name=f"wd-churn{k}") for k in range(3)]
+        for t in ts:
+            t.start()
+        _join_all(ts, "watchdog", viol)
+        if wd.fired.is_set():
+            viol.append("watchdog: fired although every span was "
+                        "disarmed well inside its 30s deadline")
+        wd.stop()
+        if wd._thread.is_alive():
+            viol.append("watchdog: monitor thread survived stop()")
+
+        # phase 2: an abandoned arm must fire (and name its span)
+        wd2 = health.Watchdog("fuzz2", deadline_s=0.05)
+        wd2.arm("abandoned-span")
+        if not wd2.fired.wait(10.0):
+            viol.append("watchdog: abandoned armed span never fired "
+                        "within 10s (deadline 0.05s)")
+        wd2.stop()
+        if wd2._thread.is_alive():
+            viol.append("watchdog: monitor thread survived stop() "
+                        "after a fire")
+    finally:
+        if old_forensic is None:
+            os.environ.pop("LGBM_TPU_FORENSIC", None)
+        else:
+            os.environ["LGBM_TPU_FORENSIC"] = old_forensic
+        health.reset()
+    return viol
+
+
+# ---------------------------------------------------------------------------
+# seam: prediction server
+# ---------------------------------------------------------------------------
+class _StubModel:
+    """Duck-types the two methods PredictionServer calls on a
+    CompiledModel; scoring is a host-side row sum so results are
+    checkable without a device."""
+
+    def warm(self, buckets, binned=False):
+        self.warmed = list(buckets)
+
+    def predict(self, X, raw_score=False, binned=False, pad=False):
+        import numpy as np
+        return np.asarray(X, np.float32).sum(axis=1)
+
+
+def seam_server(rng: random.Random, tmp: str) -> List[str]:
+    import numpy as np
+
+    from lightgbm_tpu.serve.server import PredictionServer
+    viol: List[str] = []
+    srv = PredictionServer(_StubModel(), max_batch=64, max_wait_ms=0.5,
+                           warmup=True)
+    results: List[tuple] = []          # (future, expected ndarray)
+    res_lock = threading.Lock()
+
+    def submitter(seed: int) -> None:
+        r = random.Random(seed)
+        for _ in range(25):
+            rows = np.asarray(
+                [[r.uniform(-1, 1) for _ in range(4)]
+                 for _ in range(r.randrange(1, 4))], np.float32)
+            try:
+                fut = srv.submit(rows)
+            except RuntimeError:
+                return                  # closed under us: admission denied
+            with res_lock:
+                results.append((fut, rows.sum(axis=1)))
+            if r.random() < 0.3:
+                time.sleep(0)
+
+    ts = [threading.Thread(target=submitter,
+                           args=(rng.randrange(1 << 30),),
+                           name=f"srv-sub{k}") for k in range(3)]
+    for t in ts:
+        t.start()
+    time.sleep(rng.uniform(0.0, 0.02))
+    srv.close(timeout=30.0)
+    _join_all(ts, "server", viol)
+    for fut, want in results:
+        if not fut.done():
+            # exactly-once delivery: an admitted request fell into the
+            # submit-vs-drain crack and its future will never resolve
+            viol.append("server: admitted request's future never "
+                        "resolved (submit raced the close drain)")
+            continue
+        if fut.exception() is not None:
+            viol.append(f"server: request failed: {fut.exception()!r}")
+            continue
+        got = np.atleast_1d(np.asarray(fut.result()))
+        if got.shape != want.shape or not np.allclose(got, want,
+                                                      atol=1e-5):
+            viol.append(f"server: wrong result (cross-request mixup): "
+                        f"got {got!r} want {want!r}")
+    st = srv.stats()
+    if st["submitted"] != st["resolved"] + st["failed"]:
+        viol.append(f"server: accounting leak — submitted "
+                    f"{st['submitted']} != resolved {st['resolved']} + "
+                    f"failed {st['failed']}")
+    if st["pending"] != 0:
+        viol.append(f"server: {st['pending']} request(s) still pending "
+                    f"after close()")
+    if srv._thread.is_alive():
+        viol.append("server: worker thread survived close()")
+    return viol
+
+
+# ---------------------------------------------------------------------------
+# seam: elastic coordinator
+# ---------------------------------------------------------------------------
+def seam_coord(rng: random.Random, tmp: str) -> List[str]:
+    from lightgbm_tpu.parallel import elastic
+    from lightgbm_tpu.utils import faults
+    viol: List[str] = []
+    coord = elastic.ElasticCoordinator(
+        heartbeat_timeout_s=1.0,
+        ledger_path=os.path.join(tmp, f"coord-{rng.randrange(1 << 30)}"
+                                      ".jsonl"))
+    addr = coord.start()
+    samples: List[Dict] = []
+    stop_sampling = threading.Event()
+
+    def sampler() -> None:
+        while not stop_sampling.is_set():
+            samples.append(coord.membership())
+            time.sleep(0.005)
+
+    def churn(tid: int, seed: int) -> None:
+        r = random.Random(seed)
+        for _ in range(2):
+            c = elastic.ElasticClient(addr, member=f"fuzz-{tid}",
+                                      deadline_s=10.0,
+                                      heartbeat_interval_s=0.05)
+            try:
+                c.join_world()
+                time.sleep(r.uniform(0.0, 0.05))
+                c.leave()
+            except (elastic.GenerationChanged, elastic.EvictedError,
+                    elastic.RankLostError):
+                pass                    # typed churn outcomes are legal
+            finally:
+                c.close()
+
+    sm = threading.Thread(target=sampler, name="coord-sampler")
+    sm.start()
+    ts = [threading.Thread(target=churn,
+                           args=(k, rng.randrange(1 << 30)),
+                           name=f"coord-churn{k}") for k in range(3)]
+    for t in ts:
+        t.start()
+    # mid-churn, evict the newest member as a lost rank
+    time.sleep(rng.uniform(0.0, 0.05))
+    faults.inject("rendezvous.drop_rank", times=1)
+    _join_all(ts, "coord", viol)
+    faults.clear("rendezvous.drop_rank")
+    stop_sampling.set()
+    sm.join(5.0)
+    final = coord.membership()
+    coord.stop()
+
+    gen = -1
+    for s in samples + [final]:
+        if s["generation"] < gen:
+            viol.append(f"coord: generation moved backwards "
+                        f"({gen} -> {s['generation']})")
+        gen = max(gen, s["generation"])
+        members = s["members"]
+        ranks = sorted(m["rank"] for m in members)
+        if ranks != list(range(len(members))):
+            viol.append(f"coord: rank map not contiguous 0..W-1: "
+                        f"{ranks} at generation {s['generation']}")
+        by_id = sorted(members, key=lambda m: m["member"])
+        if [m["rank"] for m in by_id] != list(range(len(by_id))):
+            viol.append(
+                f"coord: ranks not in sorted member-id order at "
+                f"generation {s['generation']}: "
+                f"{[(m['member'], m['rank']) for m in by_id]} — the "
+                f"deterministic rank law is broken")
+    if final["world"] != 0:
+        viol.append(f"coord: {final['world']} member(s) left behind "
+                    f"after every client left")
+    return viol
+
+
+SEAMS: Dict[str, Callable[[random.Random, str], List[str]]] = {
+    "ledger": seam_ledger,
+    "watchdog": seam_watchdog,
+    "server": seam_server,
+    "coord": seam_coord,
+}
+
+
+def run_seeds(seeds: int, seams: List[str]) -> List[str]:
+    """Run every seam under ``seeds`` randomized schedules; returns the
+    violation list (empty = clean)."""
+    from lightgbm_tpu.obs import lock_contract
+    failures: List[str] = []
+    old_interval = sys.getswitchinterval()
+    try:
+        for seed in range(seeds):
+            rng = random.Random(seed)
+            sys.setswitchinterval(rng.choice(_SWITCH_INTERVALS))
+            lock_contract.reset()
+            with tempfile.TemporaryDirectory(
+                    prefix="lgbm-tpu-interleave-") as tmp:
+                for name in seams:
+                    sub = random.Random(rng.randrange(1 << 30))
+                    for v in SEAMS[name](sub, tmp):
+                        failures.append(f"seed {seed} seam {name}: {v}")
+            for v in lock_contract.violations():
+                failures.append(f"seed {seed} lock contract: "
+                                f"{v.get('detail', v)}")
+    finally:
+        sys.setswitchinterval(old_interval)
+        lock_contract.reset()
+    return failures
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m tools.interleave",
+        description="schedule fuzzer for the package's thread seams")
+    ap.add_argument("--seeds", type=int, default=None,
+                    help="schedules per seam (default "
+                         "LGBM_TPU_INTERLEAVE_SEEDS, else 3)")
+    ap.add_argument("--seams", default=",".join(SEAMS),
+                    help=f"comma list from: {','.join(SEAMS)}")
+    try:
+        args = ap.parse_args(argv)
+    except SystemExit as exc:
+        return 2 if exc.code not in (0, None) else 0
+    if args.seeds is None:
+        raw = os.environ.get("LGBM_TPU_INTERLEAVE_SEEDS", "")
+        try:
+            args.seeds = int(raw) if raw else 3
+        except ValueError:
+            print(f"bad LGBM_TPU_INTERLEAVE_SEEDS: {raw!r}",
+                  file=sys.stderr)
+            return 2
+    seams = [s.strip() for s in args.seams.split(",") if s.strip()]
+    unknown = [s for s in seams if s not in SEAMS]
+    if unknown or not seams or args.seeds < 1:
+        print(f"unknown seam(s) {unknown} (have: {','.join(SEAMS)})"
+              if unknown else "need >=1 seed and >=1 seam",
+              file=sys.stderr)
+        return 2
+    t0 = time.perf_counter()
+    failures = run_seeds(args.seeds, seams)
+    dt = time.perf_counter() - t0
+    if failures:
+        for f in failures:
+            print(f"INTERLEAVE {f}")
+        print(f"interleave: {len(failures)} violation(s) across "
+              f"{args.seeds} seed(s) ({dt:.1f}s)")
+        return 1
+    print(f"interleave: clean ({args.seeds} seed(s) x "
+          f"{len(seams)} seam(s), {dt:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
