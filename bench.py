@@ -1425,6 +1425,85 @@ def elastic_leg(line=None, dryrun: bool = False):
     return out
 
 
+NUM_CONTRACT_SCHEMA_KEYS = (
+    "num_contract_rows", "num_contract_iters", "num_contract_windows",
+    "num_contract_max_drift_ulps", "num_contract_budget_ulps",
+    "num_contract_budget_name", "num_contract_trips",
+    "num_contract_ok", "num_reassoc_drift_proof_ok")
+
+
+def num_contract_leg(dryrun: bool = False):
+    """Numerics ulp-contract gate (ISSUE 19), two halves:
+
+    1. a toy training run with the runtime contract armed
+       (``LGBM_TPU_NUM_CONTRACT=1``, ``obs/num_contract.py``): every
+       window's canonical-f32-vs-f64-oracle drift must stay within the
+       registered ``score_root_ulp`` budget — zero trips
+       (``num_contract_ok``);
+    2. the wall must TRIP when the hazard is real: a child process
+       re-runs the S=1 identity matrix (``tools/identity_check.py``)
+       with the ``num.reassoc`` fault armed from the environment (the
+       canonical root reducer silently reverts to a raw ``jnp.sum`` —
+       the PR 14 bug class) and must exit nonzero naming the first
+       diverging partition pair (``num_reassoc_drift_proof_ok``).
+    """
+    import subprocess
+    import sys as _sys
+
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.obs import num_contract
+    import jax
+
+    toy = dryrun or jax.default_backend() != "tpu"
+    rows = int(os.environ.get("BENCH_NUM_ROWS", 4_096 if toy else 200_000))
+    iters = int(os.environ.get("BENCH_NUM_ITERS", 4))
+    rng = np.random.default_rng(19)
+    X = rng.normal(size=(rows, 6)).astype(np.float32)
+    y = (X[:, 0] + 0.5 * rng.normal(size=rows) > 0).astype(np.float64)
+    params = {"objective": "binary", "num_leaves": 15, "verbose": -1,
+              "num_iterations": iters, "output_freq": 2}
+    prev = os.environ.get("LGBM_TPU_NUM_CONTRACT")
+    os.environ["LGBM_TPU_NUM_CONTRACT"] = "1"
+    try:
+        num_contract.reset()
+        lgb.train(params, lgb.Dataset(X, label=y, params=params))
+        led = num_contract.ledger()
+        trips = num_contract.trips()
+    finally:
+        if prev is None:
+            os.environ.pop("LGBM_TPU_NUM_CONTRACT", None)
+        else:
+            os.environ["LGBM_TPU_NUM_CONTRACT"] = prev
+        num_contract.reset()
+    out = {
+        "num_contract_rows": rows, "num_contract_iters": iters,
+        "num_contract_windows": len(led),
+        "num_contract_max_drift_ulps": max(
+            (d for _, d, _ in led), default=0),
+        "num_contract_budget_ulps": num_contract.ULP_BUDGET,
+        "num_contract_budget_name": num_contract.BUDGET_NAME,
+        "num_contract_trips": len(trips),
+        "num_contract_ok": bool(led) and not trips,
+    }
+    # drift proof: env-armed child (the fault resolves at import of
+    # learner/serial.py — arming in THIS process would be a no-op)
+    env = {**os.environ, "LGBM_TPU_FAULTS": "num.reassoc:1000000",
+           "JAX_PLATFORMS": "cpu"}
+    env.pop("XLA_FLAGS", None)
+    proc = subprocess.run(
+        [_sys.executable, "-m", "tools.identity_check", "--scenarios",
+         "serial,stream1", "--rows", "600", "--rounds", "6"],
+        cwd=os.path.dirname(os.path.abspath(__file__)), env=env,
+        capture_output=True, text=True, timeout=420)
+    named = [ln for ln in proc.stdout.splitlines()
+             if "first diverging pair" in ln]
+    out["num_reassoc_drift_proof_ok"] = bool(
+        proc.returncode != 0 and named)
+    if named:
+        out["num_reassoc_divergence"] = named[0].strip()
+    return out
+
+
 def _validate_north_star_aux(ns: dict):
     """Validate the extended north_star.json tables: each aux wave key
     is either a measured list of rows (positive ns/row) or a
@@ -1723,6 +1802,25 @@ def dryrun_main():
     except Exception as exc:        # noqa: BLE001 - reported on the line
         line["elastic_ok"] = False
         line["elastic_leg"] = f"failed: {type(exc).__name__}: {exc}"
+    # numerics ulp-contract gate (ISSUE 19): a toy train with
+    # LGBM_TPU_NUM_CONTRACT=1 must stay within the registered
+    # score_root_ulp budget, and an env-armed num.reassoc child must
+    # BREAK the digest law with the diverging pair named (tier-1 via
+    # tests/test_bench_budget)
+    try:
+        ncleg = num_contract_leg(dryrun=True)
+        missing = [k for k in NUM_CONTRACT_SCHEMA_KEYS if k not in ncleg]
+        line.update(ncleg)
+        line["num_contract_schema_ok"] = bool(
+            not missing
+            and ncleg["num_contract_ok"]
+            and ncleg["num_reassoc_drift_proof_ok"]
+            and ncleg["num_contract_windows"] > 0)
+        if missing:
+            line["num_contract_schema_missing"] = missing
+    except Exception as exc:        # noqa: BLE001 - reported on the line
+        line["num_contract_schema_ok"] = False
+        line["num_contract_leg"] = f"failed: {type(exc).__name__}: {exc}"
     # device-time attribution gate (ISSUE 10): the REAL leg at toy
     # shape on CPU — windowed capture, parse, schema — with the
     # acceptance floor: >=90% of captured device time attributes to
@@ -2132,6 +2230,20 @@ def main():
                     and eleg.get("elastic_recovery_ok")):
                 auc_ok = False
         _checkpoint("aux-elastic")
+
+    # numerics ulp contract (ISSUE 19): the runtime half of numcheck —
+    # a contract-armed toy train must hold the score_root_ulp budget
+    # and the env-armed num.reassoc child must break the digest law
+    # loudly.  Gate-bearing: silent numerics drift must not keep the
+    # headline green.
+    if os.environ.get("BENCH_NUM_CONTRACT", "1") != "0":
+        ncleg = _leg(line, "num_contract", num_contract_leg, gate=True)
+        if ncleg is not None:
+            line.update(ncleg)
+            if not (ncleg.get("num_contract_ok")
+                    and ncleg.get("num_reassoc_drift_proof_ok")):
+                auc_ok = False
+        _checkpoint("aux-num-contract")
 
     # 255-bin leg (VERDICT r4 #7): the EXACT docs/Experiments.rst:104-116
     # bin/leaf config (max_bin=255, 255 leaves) at reduced iterations, so

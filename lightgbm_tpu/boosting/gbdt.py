@@ -357,6 +357,9 @@ class GBDT:
         # init score from metadata (continued training / custom init)
         ms = train_set.metadata.init_score
         if ms is not None:
+            # numcheck: disable=NUM002 -- ingest cast of user-supplied
+            # init_score to the f32 score dtype: a data conversion at
+            # the model boundary, not an accumulation losing precision
             scores_np = np.asarray(ms, np.float64).reshape(
                 -1, K, order="F").astype(np.float32)
         elif c.boost_from_average and self.objective is not None:
@@ -1641,7 +1644,7 @@ class GBDT:
         keyed RNG derivation site counts into the RNG ledger — the
         runtime reproducibility contract the ``tools/replay_check.py``
         train-twice harness asserts on."""
-        from ..obs import determinism, health, ops_plane
+        from ..obs import determinism, health, num_contract, ops_plane
         from ..obs.mem_contract import maybe_watermark
         from ..obs.profiler import maybe_profile
         from ..obs.trace_contract import maybe_track
@@ -1649,6 +1652,9 @@ class GBDT:
             # a fresh train() starts a fresh ledger; a resumed run keeps
             # accumulating so its digest stream continues the dead run's
             determinism.reset()
+        if num_contract.enabled() and not self._resumed:
+            # same fresh/resumed ledger discipline for the ulp contract
+            num_contract.reset()
         # live ops plane (obs/ops_plane.py, LGBM_TPU_OPS_PORT): mount
         # the /metrics + /healthz scrape surface for this run; warming
         # until the first window lands (mark_ready below).  Host-side
@@ -1720,6 +1726,7 @@ class GBDT:
                callbacks: Sequence) -> None:
         from ..obs import determinism as _det
         from ..obs import health as _health
+        from ..obs import num_contract as _num
         c = self.config
         iters = num_iterations or c.num_iterations
         # ES bookkeeping is INSTANCE state since the fault-tolerance
@@ -1843,16 +1850,21 @@ class GBDT:
                 # flushing pending device trees costs one batched
                 # device_get per window, paid only under the contract
                 _det.window_digest(self, int(it))
-            if _health.sentinels_enabled():
-                # numerics sentinel (obs/health.py): non-finite
-                # detection over the score state at the window
-                # boundary — a host fetch like the eval below, zero
-                # extra device dispatches.  A NaN grad/hess poisons
-                # the scores it folds into, so this names the window.
+            if _health.sentinels_enabled() or _num.enabled():
+                # ONE score fetch shared by two consumers — a host
+                # fetch like the eval below, zero extra device
+                # dispatches: the non-finite sentinel (obs/health.py;
+                # a NaN grad/hess poisons the scores it folds into, so
+                # this names the window) and the ulp-drift contract
+                # (obs/num_contract.py: canonical f32 root-sum vs the
+                # f64 host oracle over the same fetched bytes).
                 s_np = (self._pr.local_np(self.scores)
                         if self._pr is not None
                         else np.asarray(self.scores))
-                _health.check_scores(s_np, window=int(it))
+                if _health.sentinels_enabled():
+                    _health.check_scores(s_np, window=int(it))
+                if _num.enabled():
+                    _num.window_check(s_np, it=int(it))
             if stop:
                 break
             if want_eval and eval_freq > 0 and it % eval_freq == 0:
@@ -2485,6 +2497,8 @@ class GBDT:
         ms = (self.train_set.metadata.init_score
               if self.train_set is not None else None)
         if ms is not None:
+            # numcheck: disable=NUM002 -- same ingest cast as _boost
+            # init: a data conversion at the model boundary
             scores_np = np.asarray(ms, np.float64).reshape(
                 -1, K, order="F").astype(np.float32)
         for it in range(len(models) // K):

@@ -579,6 +579,7 @@ class StreamTrainer:
             for it in range(start, iters):
                 stopped = self._train_one_iter(it)
                 self._finish_recovery()
+                self._window_contracts(it + 1)
                 if stopped:
                     break
                 if self.elastic is not None:
@@ -597,6 +598,26 @@ class StreamTrainer:
         self.booster.scores = self.scores     # host state IS the digest
         self.booster.trim_trailing_stumps()
         return self.booster
+
+    def _window_contracts(self, it: int) -> None:
+        """Window-boundary sampling for the reproducibility contracts
+        (``LGBM_TPU_DETERMINISM=1`` digest ledger, ``LGBM_TPU_NUM_
+        CONTRACT=1`` ulp ledger) — the streamed analog of the in-memory
+        trainer's window hook, over the SAME host score state the
+        digest law is defined on.  Zero cost when neither contract is
+        armed; skipped mid-run under elastic world > 1 where non-owned
+        blocks hold stale scores until the final ``_sync_scores``."""
+        from ..obs import determinism as _det
+        from ..obs import num_contract as _num
+        if not (_det.enabled() or _num.enabled()):
+            return
+        if self.elastic is not None and self.elastic.world > 1:
+            return
+        self.booster.scores = self.scores     # host state IS the digest
+        if _det.enabled():
+            _det.window_digest(self.booster, int(it))
+        if _num.enabled():
+            _num.window_check(self.scores, it=int(it))
 
     def _finish_recovery(self) -> None:
         """Close the open recovery episode once boosting has re-reached

@@ -231,6 +231,16 @@ class DART(GBDT):
         return np.asarray(out, np.int64)
 
 
+def _abs_grad_importance(G, H):
+    """GOSS per-row importance: sum over classes of ``|g*h|``.
+
+    The class axis K is never partitioned (rows shard, classes
+    replicate) and the importance only RANKS rows, so the operand order
+    is partition-independent — registered as a sanctioned numcheck
+    context (tools/numcheck/reduction_registry.py)."""
+    return jnp.sum(jnp.abs(G * H), axis=1)
+
+
 class GOSS(GBDT):
     """Gradient-based One-Side Sampling (reference goss.hpp:36-214): keep
     the top `top_rate` rows by |grad·hess|, sample `other_rate` of the rest
@@ -257,7 +267,7 @@ class GOSS(GBDT):
                   else self.num_data)
         top_k = max(1, int(n_real * a))
         # importance = sum over classes of |g*h| (goss.hpp BaggingHelper)
-        imp = jnp.sum(jnp.abs(G * H), axis=1)
+        imp = _abs_grad_importance(G, H)
         if valid is not None:
             imp = jnp.where(valid, imp, -1.0)
         threshold = jnp.sort(imp)[-top_k]

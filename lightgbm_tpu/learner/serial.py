@@ -193,12 +193,38 @@ def reduce_chunk_sums(cs: jnp.ndarray):
     return v[0], v[1], v[2]
 
 
+def _reassoc_fault_armed() -> bool:
+    # resolved ONCE at import (host side, before any tracing): the
+    # fault must not be consulted inside the traced reducer — jit
+    # would cache the answer anyway, and a host call from traced
+    # scope would drag the faults/telemetry machinery into
+    # detcheck's traced closure.  Arm via env in a fresh process
+    # (LGBM_TPU_FAULTS="num.reassoc:...").
+    from ..utils.faults import fault_flag
+    return fault_flag("num.reassoc")
+
+
+_NUM_REASSOC_FAULT = _reassoc_fault_armed()
+
+
 def root_stats(grad, hess, bag):
     """Root ``(sum_g, sum_h, cnt)`` via the canonical chunked pairwise
     reduction (replaces the old ``jnp.sum``, whose XLA ``reduce``
     order is implementation-defined, varies with the surrounding
     program, and cannot be reassembled from streamed per-block
     partials)."""
+    if _NUM_REASSOC_FAULT:
+        # the PR 14 bug, resurrected on demand: a raw reassociable
+        # reduction whose order XLA picks per-program — the identity
+        # harness (tools/identity_check.py) must name the partition
+        # pair this diverges, and numcheck's NUM001 must flag the
+        # sums below at file:line.
+        b = bag.astype(grad.dtype)
+        # numcheck: disable=NUM001 -- deliberate num.reassoc fault body
+        sg = jnp.sum(grad * b)
+        # numcheck: disable=NUM001 -- deliberate num.reassoc fault body
+        sh = jnp.sum(hess * b)
+        return sg, sh, jnp.sum(b)
     return reduce_chunk_sums(root_chunk_sums(grad, hess, bag))
 
 
@@ -1106,6 +1132,15 @@ def built_tree_path_matrices(tree: BuiltTree):
     return leafP[:L], plen[:L]
 
 
+def _select_row_leaf(sel, leaf_value):
+    """Per-row leaf value via single-nonzero selection.
+
+    Each row lands in exactly one leaf, so the leaf-axis sum picks one
+    value — exact in any order, and registered as a sanctioned numcheck
+    context (tools/numcheck/reduction_registry.py)."""
+    return jnp.sum(jnp.where(sel, leaf_value[:, None], 0.0), axis=0)
+
+
 def predict_built_tree_matmul(tree: BuiltTree, data: DeviceData,
                               bins: jnp.ndarray) -> jnp.ndarray:
     """Leaf value per row of ``bins`` with NO per-row tree walk: every
@@ -1144,4 +1179,4 @@ def predict_built_tree_matmul(tree: BuiltTree, data: DeviceData,
         P.astype(jnp.bfloat16), d2, (((1,), (0,)), ((), ())),
         preferred_element_type=jnp.float32)       # [L, n]
     sel = (S == plen[:, None].astype(jnp.float32)) & (plen[:, None] >= 0)
-    return jnp.sum(jnp.where(sel, tree.leaf_value[:, None], 0.0), axis=0)
+    return _select_row_leaf(sel, tree.leaf_value)

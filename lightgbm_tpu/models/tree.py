@@ -509,6 +509,16 @@ def stack_trees(trees: Sequence[Tree], max_bins: int = 1,
                         jnp.asarray(ic), jnp.asarray(cm), depth)
 
 
+def _sum_tree_axis(per_tree):
+    """Sum per-tree score contributions over the tree axis.
+
+    Trees are replicated model state — the tree axis is never
+    partitioned across devices or row blocks, so the operand order is
+    partition-independent and raw ``jnp.sum`` is sanctioned here
+    (tools/numcheck/reduction_registry.py)."""
+    return jnp.sum(per_tree, axis=0)
+
+
 @functools.partial(jax.jit, static_argnames=("start_tree", "num_trees"))
 def predict_binned(stacked: StackedTrees, bins: jnp.ndarray,
                    nan_bins: jnp.ndarray, zero_bins: jnp.ndarray,
@@ -542,7 +552,7 @@ def predict_binned(stacked: StackedTrees, bins: jnp.ndarray,
         trees.split_feature, trees.threshold_bin, trees.left_child,
         trees.right_child, trees.leaf_value, trees.default_left,
         trees.is_categorical, trees.cat_bin_mask)          # [T, n]
-    return jnp.sum(per_tree, axis=0)
+    return _sum_tree_axis(per_tree)
 
 
 def build_path_matrices(trees: Sequence[Tree], pad_leaves: int = 0

@@ -613,6 +613,21 @@ class LambdarankNDCG(ObjectiveFunction):
         return "lambdarank"
 
 
+def _fold_pair_grid(signed, hh, T, M):
+    """Fold one query's [T, M] pair grids to per-doc grad/hess rows.
+
+    Partition-independent by construction: rows of one query are never
+    split across shards (ranking descopes row-blocked streaming), so
+    the fold order is fixed by the in-query sort alone — registered as
+    a sanctioned numcheck context
+    (tools/numcheck/reduction_registry.py)."""
+    g_sorted = (jnp.pad(jnp.sum(signed, axis=1), (0, M - T))
+                - jnp.sum(signed, axis=0))
+    h_sorted = (jnp.pad(jnp.sum(hh, axis=1), (0, M - T))
+                + jnp.sum(hh, axis=0))
+    return g_sorted, h_sorted
+
+
 @functools.partial(jax.jit, static_argnames=("T", "C"))
 def _lambdarank_bucket_grads(s, valid, label, gain, imd, disc, sigma,
                              *, T: int, C: int):
@@ -653,10 +668,7 @@ def _lambdarank_bucket_grads(s, valid, label, gain, imd, disc, sigma,
         # accumulate in SORTED coordinates, then one inverse-permutation
         # gather back — the equivalent per-original-index scatter-adds
         # (4 of them) are the slow path on TPU
-        g_sorted = (jnp.pad(jnp.sum(signed, axis=1), (0, M - T))
-                    - jnp.sum(signed, axis=0))
-        h_sorted = (jnp.pad(jnp.sum(hh, axis=1), (0, M - T))
-                    + jnp.sum(hh, axis=0))
+        g_sorted, h_sorted = _fold_pair_grid(signed, hh, T, M)
         inv = jnp.argsort(order)
         return g_sorted[inv], h_sorted[inv]
 

@@ -380,6 +380,8 @@ def route_rows_xla(bins: jnp.ndarray,
     safe = jnp.maximum(rl, 0)
     f = feature[safe]
     g = feat_group[f]
+    # numcheck: disable=NUM001 -- int32 one-hot group select (g is
+    # feat_group, not a gradient); integer adds are exact in any order
     c = jnp.sum(jnp.where(g[:, None] == jnp.arange(bins.shape[1])[None, :],
                           bins.astype(jnp.int32), 0), axis=1)
     b = unbundle_bin(c, feat_offset[f], num_bins[f], default_bins[f])

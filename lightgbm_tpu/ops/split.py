@@ -95,6 +95,19 @@ def _split_gain(lg, lh, rg, rh, l1, l2):
             + leaf_split_gain(rg, rh, l1, l2))
 
 
+def _select_miss_bin(is_miss_cell, g, h, c):
+    """Missing-cell stats per (leaf, feature): single-nonzero selection.
+
+    ``is_miss_cell`` is one-hot over the bin axis (at most one missing
+    cell per feature), so each sum picks exactly one histogram cell —
+    exact in any order, and registered as a sanctioned numcheck context
+    (tools/numcheck/reduction_registry.py)."""
+    miss_g = jnp.sum(jnp.where(is_miss_cell[None], g, 0.0), axis=-1)     # [L, F]
+    miss_h = jnp.sum(jnp.where(is_miss_cell[None], h, 0.0), axis=-1)
+    miss_c = jnp.sum(jnp.where(is_miss_cell[None], c, 0.0), axis=-1)
+    return miss_g, miss_h, miss_c
+
+
 def find_best_splits(hist: jnp.ndarray,
                      leaf_sum_grad: jnp.ndarray,
                      leaf_sum_hess: jnp.ndarray,
@@ -207,9 +220,7 @@ def _find_best_splits_block(hist, leaf_sum_grad, leaf_sum_hess, leaf_count,
     h_scan = jnp.where(vb & ~is_miss_cell[None], h, 0.0)
     c_scan = jnp.where(vb & ~is_miss_cell[None], c, 0.0)
 
-    miss_g = jnp.sum(jnp.where(is_miss_cell[None], g, 0.0), axis=-1)     # [L, F]
-    miss_h = jnp.sum(jnp.where(is_miss_cell[None], h, 0.0), axis=-1)
-    miss_c = jnp.sum(jnp.where(is_miss_cell[None], c, 0.0), axis=-1)
+    miss_g, miss_h, miss_c = _select_miss_bin(is_miss_cell, g, h, c)     # [L, F]
 
     cl_g = jnp.cumsum(g_scan, axis=-1)                                   # [L, F, B]
     cl_h = jnp.cumsum(h_scan, axis=-1)

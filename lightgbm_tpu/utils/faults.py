@@ -70,6 +70,17 @@ the seams where production faults actually strike:
   coordinator evicts the member (the dead-rank signal), few and the
   member survives (heartbeats are retried, not load-bearing
   one-shots),
+* ``num.reassoc`` — a SILENT fault (``fault_flag``): while armed,
+  ``learner/serial.py``'s ``root_stats`` swaps its canonical
+  chunk+pairwise reduction back to a raw ``jnp.sum`` — reintroducing
+  the exact PR 14 reassociation bug class so tests prove the identity
+  harness (``tools/identity_check.py``) names the first diverging
+  partition pair while the static gate (``tools/numcheck`` NUM001)
+  flags the same hazard at file:line.  NOTE the flag is read ONCE at
+  module import (host side — a traced-scope read would both be cached
+  by jit and drag the faults machinery into detcheck's traced
+  closure): arming is only effective in a fresh process (the harness
+  re-execs an env-armed child),
 * ``collective.slow`` — a SILENT fault: the elastic client sleeps
   ``LGBM_TPU_COLLECTIVE_SLOW`` seconds (default 0.25, clamped below
   the collective deadline) BEFORE entering the allgather — a straggler
@@ -107,7 +118,11 @@ POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
           # sleeps while holding a contract-named lock
           # (obs/lock_contract.py): drives the contention-metric and
           # held-past-deadline paths in tests
-          "lock.slow_hold")
+          "lock.slow_hold",
+          # swaps the canonical chunk+pairwise root reducer back to a
+          # raw jnp.sum (learner/serial.py root_stats) — the PR 14
+          # reassociation bug class
+          "num.reassoc")
 
 
 class FaultInjected(RuntimeError):

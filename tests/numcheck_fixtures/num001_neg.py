@@ -1,0 +1,26 @@
+"""NUM001 negative: reductions that are order-safe, collective, over
+non-state operands, or justified-suppressed must stay silent."""
+import jax
+import jax.numpy as jnp
+
+
+def _n1n_untainted(weights, counts):
+    # no persistent-state names flow into the reduction
+    return jnp.sum(weights * counts)
+
+
+def _n1n_collective(grad):
+    # psum IS the sanctioned seam: the partition-pinned combine point
+    return jax.lax.psum(grad, axis_name="shards")
+
+
+def _n1n_suppressed(feat_group_hist):
+    # numcheck: disable=NUM001 -- int32 histogram of group ids:
+    # integer adds are exact in any association order
+    return jnp.sum(feat_group_hist)
+
+
+def _n1n_python_sum(grads_list):
+    # builtin sum over a python list is a Name call, not a module/
+    # method reduction — left to the registry'd jnp paths
+    return sum(float(g) for g in grads_list)

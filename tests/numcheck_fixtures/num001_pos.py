@@ -1,0 +1,21 @@
+"""NUM001 positive: raw reassociation-unsafe reductions over
+persistent training state in a jax-importing module."""
+import jax.numpy as jnp
+
+
+def _n1p_module_form(grad, hess, bag):
+    sg = jnp.sum(grad * bag)                      # EXPECT: NUM001
+    sh = jnp.sum(hess * bag)                      # EXPECT: NUM001
+    return sg, sh
+
+
+def _n1p_method_form(scores):
+    return scores.sum()                           # EXPECT: NUM001
+
+
+def _n1p_mean_over_hist(hist):
+    return jnp.mean(hist, axis=0)                 # EXPECT: NUM001
+
+
+def _n1p_keyword_taint(weights, grad):
+    return jnp.dot(weights, b=grad)               # EXPECT: NUM001

@@ -1,0 +1,20 @@
+"""NUM002 negative: f32-to-f32 casts, non-64 operands, and a
+justified-suppressed ingest cast stay silent."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def _n2n_already_f32(scores):
+    # no f64 mention anywhere in the operand subtree
+    return scores.astype(jnp.float32)
+
+
+def _n2n_widening(acc32):
+    # widening is always safe; only narrowing is the hazard
+    return acc32.astype(jnp.float64)
+
+
+def _n2n_suppressed(init_score64):
+    # numcheck: disable=NUM002 -- external ingest boundary: the f64
+    # payload is user input, not an accumulator we control
+    return np.float32(init_score64)

@@ -1,0 +1,17 @@
+"""NUM002 positive: f64-derived values narrowed to f32 with no
+registered compensation idiom."""
+import jax.numpy as jnp
+import numpy as np
+
+
+def _n2p_astype(acc64):
+    return acc64.astype(jnp.float32)              # EXPECT: NUM002
+
+
+def _n2p_ctor(total):
+    total_f64 = np.float64(total)
+    return np.float32(total_f64)                  # EXPECT: NUM002
+
+
+def _n2p_string_dtype(running_sum_f64):
+    return running_sum_f64.astype("float32")      # EXPECT: NUM002

@@ -1,0 +1,23 @@
+"""NUM003 negative: digest identity, int-valued comparisons, and
+ordering comparisons on float state stay silent."""
+
+
+def _n3n_digest(score_digest_a, score_digest_b):
+    # digest equality IS the contract numcheck exists to defend
+    return score_digest_a == score_digest_b
+
+
+def _n3n_int_valued(scores, n):
+    # len() yields an int: comparing a length, not a float
+    return len(scores) == n
+
+
+def _n3n_ordering(gain, best_gain):
+    # strict ordering on floats is fine; only == / != is the hazard
+    return gain > best_gain
+
+
+def _n3n_suppressed(threshold, raw_threshold):
+    # numcheck: disable=NUM003 -- bin thresholds are COPIED, never
+    # recomputed: bitwise equality is the load-roundtrip contract
+    return threshold == raw_threshold

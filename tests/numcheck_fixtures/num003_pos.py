@@ -1,0 +1,17 @@
+"""NUM003 positive: exact float equality on score/metric-flavored
+operands in package (non-test) code."""
+
+
+def _n3p_eq(score_a, score_b):
+    return score_a == score_b                     # EXPECT: NUM003
+
+
+def _n3p_ne(best_gain, gain):
+    if best_gain != gain:                         # EXPECT: NUM003
+        return True
+    return False
+
+
+def _n3p_metric(metrics):
+    # EXPECT-NEXT: NUM003
+    return metrics["auc"] == 1.0

@@ -1,0 +1,19 @@
+"""NUM004 negative: registered values, named lookups, and non-numeric
+tolerance expressions stay silent."""
+import numpy as np
+
+
+def _n4n_registered_value(a, b):
+    # 1e-6 is a registered row (f32_tight): value-resolution covers
+    # the unmigrated long tail
+    np.testing.assert_allclose(a, b, atol=1e-6)
+
+
+def _n4n_named_lookup(a, b, tol):
+    # the migrated shape: a tol('<id>') call is not a literal at all
+    np.testing.assert_allclose(a, b, atol=tol("f32_accum"))
+
+
+def _n4n_expression(a, b, eps):
+    # non-constant expressions are budget plumbing, not new budgets
+    np.testing.assert_allclose(a, b, atol=4 * eps)

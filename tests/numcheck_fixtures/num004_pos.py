@@ -1,0 +1,15 @@
+"""NUM004 positive: tolerance keywords carrying numeric literals that
+resolve to no row of tolerance_registry.py (fires in tests too)."""
+import numpy as np
+
+
+def _n4p_allclose(a, b):
+    np.testing.assert_allclose(a, b, atol=7e-6)   # EXPECT: NUM004
+
+
+def _n4p_rtol(a, b):
+    np.testing.assert_allclose(a, b, rtol=3.3e-4)  # EXPECT: NUM004
+
+
+def _n4p_envelope(env, preds):
+    return env.check(preds, value_margin=0.042)   # EXPECT: NUM004

@@ -1,0 +1,28 @@
+"""NUM005 negative: plain adds, unfenced names, and a justified
+suppression stay silent."""
+import jax.numpy as jnp
+
+
+def _n5n_plain_add(scores, delta):
+    # no multiply inside the add: nothing for XLA to contract
+    scores = scores + delta
+    return scores
+
+
+def _n5n_unfenced_name(acc, lr, delta):
+    # 'acc' is not registered fenced state
+    acc = acc + lr * delta
+    return acc
+
+
+def _n5n_prescaled(scores, scaled_leaf, idx):
+    # the blessed shape: scaling happened BEFORE the gather/add seam
+    scores = scores.at[idx].add(jnp.take(scaled_leaf, idx))
+    return scores
+
+
+def _n5n_suppressed(vs, lr, delta):
+    # numcheck: disable=NUM005 -- eager-mode debug path, never traced:
+    # no fusion context, so no FMA-contraction hazard
+    vs = vs + lr * delta
+    return vs

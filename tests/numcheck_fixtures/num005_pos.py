@@ -1,0 +1,18 @@
+"""NUM005 positive: bare mul+add updates of registered fenced score
+state in a jax-importing module (FMA-contraction bait)."""
+import jax.numpy as jnp
+
+
+def _n5p_assign(scores, lr, delta):
+    scores = scores + lr * delta                  # EXPECT: NUM005
+    return scores
+
+
+def _n5p_augassign(vscores, lr, leaf):
+    vscores += lr * jnp.take(leaf, 0)             # EXPECT: NUM005
+    return vscores
+
+
+class _N5PBooster:
+    def _n5p_attr_target(self, lr, delta):
+        self.scores = self.scores + delta * lr    # EXPECT: NUM005

@@ -102,7 +102,7 @@ def test_deadline_skips_aux_legs_with_markers(bench_run):
     assert set(final.get("legs_skipped", [])) >= {
         "serve", "serve_load", "valid", "bin255", "rank", "rank63",
         "multichip", "split_finder", "rank_grad", "attribution", "stream",
-        "elastic"}
+        "elastic", "num_contract"}
     # an explicit skip is not a failure: no legs_failed / hard-failed
     assert "legs_failed" not in final
     assert "legs_hard_failed" not in final
@@ -118,9 +118,12 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
            "PYTHONPATH": REPO + os.pathsep + os.environ.get(
                "PYTHONPATH", "")}
     env.pop("XLA_FLAGS", None)
+    # 600 s: the num_contract leg (ISSUE 19) adds an in-process
+    # contract-armed toy train plus a drift-proof child that trains the
+    # identity matrix on top of the elastic chaos subprocess
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--dryrun"],
-        cwd=REPO, env=env, capture_output=True, text=True, timeout=420)
+        cwd=REPO, env=env, capture_output=True, text=True, timeout=600)
     assert proc.returncode == 0, proc.stdout + proc.stderr
     lines = _parse_lines(proc.stdout)
     assert lines, proc.stdout
@@ -269,6 +272,25 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     assert abs(sum(phases.values()) - out["elastic_mttr_s"]) < 1e-9
     assert out["north_star_aux_detail"]["elastic"] in (
         "measured", "pending-capture"), out["north_star_aux_detail"]
+    # numerics ulp-contract gate (ISSUE 19): the contract-armed toy
+    # train held the score_root_ulp budget on every output window, and
+    # the env-armed num.reassoc child (raw jnp.sum in place of the
+    # canonical chunk+pairwise root reducer) broke the digest law
+    # LOUDLY — identity_check exits nonzero and names the first
+    # diverging partition pair
+    assert out["num_contract_schema_ok"] is True, out.get(
+        "num_contract_leg", out.get("num_contract_schema_missing"))
+    from bench import NUM_CONTRACT_SCHEMA_KEYS
+    for key in NUM_CONTRACT_SCHEMA_KEYS:
+        assert key in out, key
+    assert out["num_contract_ok"] is True
+    assert out["num_contract_windows"] > 0
+    assert out["num_contract_trips"] == 0
+    assert out["num_contract_max_drift_ulps"] <= \
+        out["num_contract_budget_ulps"]
+    assert out["num_contract_budget_name"] == "score_root_ulp"
+    assert out["num_reassoc_drift_proof_ok"] is True
+    assert "first diverging pair" in out["num_reassoc_divergence"]
     # device-time attribution gate (ISSUE 10): the REAL leg ran at toy
     # shape — windowed LGBM_TPU_PROFILE capture, parsed, >= 90% of the
     # captured device time attributed to named spans, host-gap and

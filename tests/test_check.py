@@ -1,16 +1,18 @@
 """Tier-1 gate: the umbrella static-analysis CLI (``python -m
-tools.check``) — all five analyzers over one shared AST parse.
+tools.check``) — all six analyzers over one shared AST parse.
 
 Replaces the per-analyzer clean-CLI tests (tpulint/spmdcheck each used
-to spawn their own subprocess): one subprocess now proves all five
+to spawn their own subprocess): one subprocess now proves all six
 package gates exit clean, and the combined wall-clock is asserted
 against the sum of the individual CLIs plus a fixed allowance — the
 shared-parse contract stated in ISSUE 8 (an umbrella that re-parsed
 per analyzer would blow this budget as the package grows).  The
-allowance grew 3 s -> 4.5 s when detcheck joined (ISSUE 12) and
-4.5 s -> 9 s when concheck joined (ISSUE 18, within its <= +5 s
-budget): the late-joining analyzers together must still ride the
-shared parse for roughly the cost of their rule passes alone.
+allowance grew 3 s -> 4.5 s when detcheck joined (ISSUE 12),
+4.5 s -> 9 s when concheck joined (ISSUE 18) and 9 s -> 14 s when
+numcheck joined (ISSUE 19, within its <= +5 s budget — numcheck also
+sweeps ``tests/`` for the tolerance rule, the only gate that does):
+the late-joining analyzers together must still ride the shared parse
+for roughly the cost of their rule passes alone.
 """
 import os
 import subprocess
@@ -29,10 +31,11 @@ def _timed_cli(module):
 
 
 def test_umbrella_clean_within_combined_budget():
-    """`python -m tools.check` exits 0 on the package (all five gates
+    """`python -m tools.check` exits 0 on the package (all six gates
     clean vs their EMPTY baselines) in <= tpulint + spmdcheck CLI time
-    + 9 s (memcheck, detcheck AND concheck ride the shared parse for
-    the cost of their rule passes alone)."""
+    + 14 s (memcheck, detcheck, concheck AND numcheck ride the shared
+    parse for the cost of their rule passes alone — numcheck's extra
+    ``tests/`` tolerance sweep included)."""
     tpl, t_tpl = _timed_cli("tools.tpulint")
     spm, t_spm = _timed_cli("tools.spmdcheck")
     assert tpl.returncode == 0, tpl.stdout + tpl.stderr
@@ -41,11 +44,11 @@ def test_umbrella_clean_within_combined_budget():
     chk, t_chk = _timed_cli("tools.check")
     assert chk.returncode == 0, chk.stdout + chk.stderr
     for name in ("tpulint", "spmdcheck", "memcheck", "detcheck",
-                 "concheck"):
+                 "concheck", "numcheck"):
         assert f"{name}: clean" in chk.stdout, chk.stdout
-    assert t_chk <= t_tpl + t_spm + 9.0, (
+    assert t_chk <= t_tpl + t_spm + 14.0, (
         f"umbrella {t_chk:.2f}s > tpulint {t_tpl:.2f}s + spmdcheck "
-        f"{t_spm:.2f}s + 9s: the shared-parse contract regressed")
+        f"{t_spm:.2f}s + 14s: the shared-parse contract regressed")
 
 
 def test_umbrella_fails_on_seeded_hazard(tmp_path):
@@ -70,24 +73,25 @@ def test_umbrella_fails_on_seeded_hazard(tmp_path):
 
 
 def test_in_process_cache_shares_one_run():
-    """The five gate tests share one analysis: a second cached_run_all
+    """The six gate tests share one analysis: a second cached_run_all
     for the same root returns the SAME object, not a re-run."""
     from tools.check import cached_run_all
     a = cached_run_all(REPO)
     b = cached_run_all(REPO)
     assert a is b
     assert set(a) == {"tpulint", "spmdcheck", "memcheck", "detcheck",
-                      "concheck"}
+                      "concheck", "numcheck"}
 
 
-def test_umbrella_fails_on_seeded_det_and_con_hazards(tmp_path):
-    """The fourth AND fifth walls are wired into the combined gate:
-    one package copy seeded with a stateful-RNG hazard and an
+def test_umbrella_fails_on_seeded_det_con_num_hazards(tmp_path):
+    """The fourth, fifth AND sixth walls are wired into the combined
+    gate: one package copy seeded with a stateful-RNG hazard, an
     unguarded write to registry-guarded state from a thread entry
-    point flips `python -m tools.check` red with BOTH rule ids in one
-    run.  Project rules stay ON (the lock registry is what makes the
-    CON seed a finding; the package itself is registry-clean, so the
-    two seeds are the only findings)."""
+    point, and a raw reassociable reduction over gradient state flips
+    `python -m tools.check` red with ALL THREE rule ids in one run.
+    Project rules stay ON (the lock/reduction registries are what make
+    the CON/NUM seeds findings; the package itself is registry-clean,
+    so the three seeds are the only findings)."""
     import shutil
     pkg = tmp_path / "lightgbm_tpu"
     shutil.copytree(os.path.join(REPO, "lightgbm_tpu"), pkg,
@@ -103,6 +107,10 @@ def test_umbrella_fails_on_seeded_det_and_con_hazards(tmp_path):
         "\n\ndef handle():\n"
         "    global _count\n"
         "    _count = _count + 1\n"))
+    target = pkg / "learner" / "serial.py"
+    target.write_text(target.read_text() + (
+        "\n\ndef _num_probe_root(grad, hess, bag):\n"
+        "    return jnp.sum(grad * bag), jnp.sum(hess * bag)\n"))
     proc = subprocess.run(
         [sys.executable, "-m", "tools.check", "--root", str(tmp_path),
          "lightgbm_tpu"],
@@ -110,3 +118,4 @@ def test_umbrella_fails_on_seeded_det_and_con_hazards(tmp_path):
     assert proc.returncode == 1, proc.stdout + proc.stderr
     assert "DET001" in proc.stdout, proc.stdout
     assert "CON001" in proc.stdout, proc.stdout
+    assert "NUM001" in proc.stdout, proc.stdout

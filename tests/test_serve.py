@@ -26,6 +26,7 @@ from lightgbm_tpu.serve import (PredictionServer, compile_model,
                                 compile_trees, next_bucket)
 from lightgbm_tpu.utils import faults
 from lightgbm_tpu.utils.retry import RetryPolicy
+from tools.numcheck.tolerance_registry import tol  # noqa: E402
 
 
 @pytest.fixture(autouse=True)
@@ -161,7 +162,7 @@ def test_parity_reference_text_roundtrip():
     # and the loaded Booster's own device surface agrees with its host path
     host = loaded.predict(Xq, raw_score=True)
     dev = loaded.predict(Xq, raw_score=True, device=True)
-    np.testing.assert_allclose(dev, host, atol=1e-6, rtol=1e-6)
+    np.testing.assert_allclose(dev, host, atol=tol("f32_tight"), rtol=tol("f32_tight"))
 
 
 def test_binned_fast_path_int8_and_equality():
@@ -213,17 +214,17 @@ def test_truncation_unified_multiclass():
     # raw truncation matches the oracle over the same prefix
     raw2 = bst.predict(Xq, num_iteration=2, raw_score=True)
     np.testing.assert_allclose(
-        raw2, _oracle(g.models[:6], Xq, K=3), atol=1e-5)
+        raw2, _oracle(g.models[:6], Xq, K=3), atol=tol("f32_accum"))
     # device path slices identically (compiled per truncation)
     dev2 = bst.predict(Xq, num_iteration=2, raw_score=True, device=True)
-    np.testing.assert_allclose(dev2, raw2, atol=1e-5)
+    np.testing.assert_allclose(dev2, raw2, atol=tol("f32_accum"))
     dev_leaf2 = bst.predict(Xq, num_iteration=2, pred_leaf=True,
                             device=True)
     assert np.array_equal(dev_leaf2, cut)
     # best_iteration drives the default exactly like explicit slicing
     bst.best_iteration = 2
     np.testing.assert_allclose(bst.predict(Xq, raw_score=True), raw2,
-                               atol=0)
+                               atol=tol("exact"))
     assert np.array_equal(bst.predict(Xq, pred_leaf=True), cut)
 
 
@@ -235,7 +236,7 @@ def test_truncation_roundtrip_vs_saved_model():
     # the loaded one via the f64 host walk — f32-level agreement
     np.testing.assert_allclose(
         bst.predict(Xq, num_iteration=3, raw_score=True),
-        cut.predict(Xq, raw_score=True), atol=2e-5, rtol=1e-5)
+        cut.predict(Xq, raw_score=True), atol=tol("f32_accum_2x"), rtol=tol("f32_accum"))
     # the DEVICE paths of both slice identically and agree to 1 ulp
     np.testing.assert_array_equal(
         bst.predict(Xq, num_iteration=3, pred_leaf=True, device=True),
@@ -251,7 +252,7 @@ def test_booster_device_matches_host():
     for raw in (True, False):
         host = bst.predict(Xq, raw_score=raw)
         dev = bst.predict(Xq, raw_score=raw, device=True)
-        np.testing.assert_allclose(dev, host, atol=2e-5, rtol=1e-5)
+        np.testing.assert_allclose(dev, host, atol=tol("f32_accum_2x"), rtol=tol("f32_accum"))
     # the compiled pack is cached per (length, truncation)
     cm1 = bst._device_predictor(-1)
     assert bst._device_predictor(-1) is cm1
@@ -263,7 +264,7 @@ def test_booster_device_env_default(monkeypatch):
     host = bst.predict(Xq, raw_score=True)
     monkeypatch.setenv("LGBM_TPU_PREDICT_DEVICE", "1")
     dev = bst.predict(Xq, raw_score=True)       # device by default now
-    np.testing.assert_allclose(dev, host, atol=2e-5, rtol=1e-5)
+    np.testing.assert_allclose(dev, host, atol=tol("f32_accum_2x"), rtol=tol("f32_accum"))
     assert getattr(bst, "_serve_cache", None)   # proved it took serve path
 
 
@@ -275,7 +276,7 @@ def test_sklearn_device_passthrough():
     clf.fit(X, y)
     p_host = clf.predict_proba(X[:100])
     p_dev = clf.predict_proba(X[:100], device=True)
-    np.testing.assert_allclose(p_dev, p_host, atol=2e-5, rtol=1e-5)
+    np.testing.assert_allclose(p_dev, p_host, atol=tol("f32_accum_2x"), rtol=tol("f32_accum"))
     assert np.array_equal(clf.predict(X[:100], device=True),
                           clf.predict(X[:100]))
 
@@ -284,13 +285,13 @@ def test_engine_predict_surface(tmp_path):
     bst, _, _ = _train(n=600, num_iterations=3)
     Xq = _query(bst, n=100)
     want = bst.predict(Xq)
-    np.testing.assert_allclose(lgb.predict(bst, Xq), want, atol=0)
+    np.testing.assert_allclose(lgb.predict(bst, Xq), want, atol=tol("exact"))
     np.testing.assert_allclose(
-        lgb.predict(bst.model_to_string(), Xq), want, atol=2e-5, rtol=1e-5)
+        lgb.predict(bst.model_to_string(), Xq), want, atol=tol("f32_accum_2x"), rtol=tol("f32_accum"))
     path = str(tmp_path / "m.txt")
     bst.save_model(path)
     np.testing.assert_allclose(
-        lgb.predict(path, Xq, device=True), want, atol=5e-5, rtol=1e-4)
+        lgb.predict(path, Xq, device=True), want, atol=tol("f32_accum_5x"), rtol=tol("f32_sum_wide"))
     with pytest.raises(TypeError):
         lgb.predict(12345, Xq)
 
@@ -308,7 +309,7 @@ def test_capi_device_env(monkeypatch):
         h, Xq.ctypes.data, cb._DTYPE_FLOAT64, 50, Xq.shape[1], 1,
         cb._PREDICT_NORMAL, -1, out.ctypes.data)
     assert n == 50
-    np.testing.assert_allclose(out, want, atol=2e-5, rtol=1e-5)
+    np.testing.assert_allclose(out, want, atol=tol("f32_accum_2x"), rtol=tol("f32_accum"))
     cb.free_handle(h)
 
 

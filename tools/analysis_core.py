@@ -1,13 +1,14 @@
 """Shared plumbing for the repo's static analyzers (tpulint, spmdcheck,
-memcheck, detcheck, concheck): file loading, one process-wide AST cache, inline
-suppression parsing, the content-keyed baseline, and the fixture EXPECT
-matcher.
+memcheck, detcheck, concheck, numcheck): file loading, one process-wide
+AST cache, inline suppression parsing, the content-keyed baseline, and
+the fixture EXPECT matcher.
 
 History: this started life as ``tools/tpulint/core.py`` (PR 3) and was
 imported wholesale by spmdcheck (PR 4).  With memcheck as the third
 consumer the plumbing moved here (``tools/tpulint/core.py`` remains a
 re-export shim so existing imports keep working); detcheck (PR 12) is
-the fourth rider and concheck (PR 18) the fifth.
+the fourth rider, concheck (PR 18) the fifth, and numcheck (PR 19) the
+sixth.
 
 Design invariants every analyzer relies on:
 
@@ -46,7 +47,8 @@ from typing import Dict, List, Optional, Sequence, Set, Tuple
 # rule ids (rule-id sets are disjoint, so cross-tag suppression is
 # harmless and occasionally handy when one line trips two analyzers)
 _SUPPRESS_RE = re.compile(
-    r"#\s*(?:tpulint|spmdcheck|memcheck|detcheck|concheck):\s*disable="
+    r"#\s*(?:tpulint|spmdcheck|memcheck|detcheck|concheck|numcheck):"
+    r"\s*disable="
     r"([A-Za-z0-9_,\s]+?)(?:\s*--\s*(.*))?\s*$")
 
 # fixture EXPECT markers (tests): `# EXPECT: TPL001` on the flagged

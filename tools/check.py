@@ -1,11 +1,15 @@
 """Umbrella static gate: ``python -m tools.check [--root R] [paths...]``.
 
-Runs all five analyzers — tpulint (TPL000-TPL008), spmdcheck
+Runs all six analyzers — tpulint (TPL000-TPL008), spmdcheck
 (SPM001-SPM004), memcheck (MEM001-MEM005), detcheck (DET001-DET006),
-concheck (CON000-CON006) — over ONE shared AST parse (``tools/analysis_core.py``'s process-wide
+concheck (CON000-CON006), numcheck (NUM000-NUM005) — over ONE shared
+AST parse (``tools/analysis_core.py``'s process-wide
 cache: each file is parsed exactly once no matter how many analyzers
 visit it) and diffs each against its own committed baseline.  Exit 0 =
 all clean, 1 = any new finding, 2 = usage error.
+
+numcheck additionally sweeps ``tests/`` (tolerance-literal discipline
+lives in test files) when the default package path is analyzed.
 
 This is what the tier-1 gate tests call (``tests/test_tpulint.py`` /
 ``test_spmdcheck.py`` / ``test_memcheck.py`` / ``test_detcheck.py``
@@ -32,14 +36,21 @@ def run_all(paths: Sequence[str] = ("lightgbm_tpu",),
             root: Optional[str] = None,
             project_rules: bool = True,
             ) -> Dict[str, Tuple[List[Finding], List[Finding]]]:
-    """Run the five analyzers over one parse; -> name ->
+    """Run the six analyzers over one parse; -> name ->
     (all_findings, new_vs_baseline)."""
     from tools.concheck import (BASELINE_DEFAULT as CON_BL, run_concheck)
     from tools.detcheck import (BASELINE_DEFAULT as DET_BL, run_detcheck)
     from tools.memcheck import (BASELINE_DEFAULT as MEM_BL, run_memcheck)
+    from tools.numcheck import (BASELINE_DEFAULT as NUM_BL, run_numcheck)
     from tools.spmdcheck import (BASELINE_DEFAULT as SPM_BL, run_spmdcheck)
     from tools.tpulint import (BASELINE_DEFAULT as TPL_BL, run_lint)
     root = os.path.abspath(root or os.getcwd())
+    # numcheck's NUM004 (tolerance discipline) lives in test files: when
+    # the stock package path is analyzed, extend its sweep to tests/
+    num_paths = tuple(paths)
+    if num_paths == ("lightgbm_tpu",) \
+            and os.path.isdir(os.path.join(root, "tests")):
+        num_paths = num_paths + ("tests",)
     out: Dict[str, Tuple[List[Finding], List[Finding]]] = {}
     for name, runner, bl in (
             ("tpulint",
@@ -57,7 +68,11 @@ def run_all(paths: Sequence[str] = ("lightgbm_tpu",),
             ("concheck",
              lambda: run_concheck(paths, root=root,
                                   project_rules=project_rules),
-             CON_BL)):
+             CON_BL),
+            ("numcheck",
+             lambda: run_numcheck(num_paths, root=root,
+                                  project_rules=project_rules),
+             NUM_BL)):
         findings, by_rel = runner()
         baseline = load_baseline(os.path.join(root, bl))
         out[name] = (findings, new_findings(findings, by_rel, baseline))
@@ -82,8 +97,8 @@ def main(argv: Optional[List[str]] = None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m tools.check",
         description="combined static gate: tpulint + spmdcheck + "
-                    "memcheck + detcheck + concheck over one shared "
-                    "AST parse")
+                    "memcheck + detcheck + concheck + numcheck over "
+                    "one shared AST parse")
     parser.add_argument("paths", nargs="*", default=["lightgbm_tpu"])
     parser.add_argument("--root", default=None,
                         help="project root (default: cwd)")

@@ -166,6 +166,11 @@ EXEMPT_ENV: Dict[str, str] = {
     "LGBM_TPU_MEM_LEAK_ELEMS": "fault-injection sink sizing (tests)",
     "LGBM_TPU_DETERMINISM": "observability: the determinism contract "
                             "itself (digest sampling + RNG ledger)",
+    "LGBM_TPU_NUM_CONTRACT": "observability: the runtime ulp contract "
+                             "(obs/num_contract.py) — per-window "
+                             "canonical-vs-f64-oracle drift ledger "
+                             "riding the existing score fetch; "
+                             "measures numerics, never changes them",
     "LGBM_TPU_FLIGHT_RECORDER": "observability: collective fingerprint "
                                 "ring; never alters the schedule",
     "LGBM_TPU_FR_CAP": "flight-recorder ring size",

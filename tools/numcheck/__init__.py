@@ -1,0 +1,71 @@
+"""numcheck — numeric-reproducibility discipline analyzer.
+
+The sixth static gate (after tpulint, spmdcheck, memcheck, detcheck,
+concheck), aimed at the floating-point hazards the byte-identity
+contract rests on: reassociation-unsafe reductions over persistent
+state (the PR 14 bug class), uncompensated wide-to-narrow casts,
+float ``==`` outside digest identity, unregistered tolerance magic
+constants, and unfenced mul+add score updates (the FMA-contraction
+lesson).  Rules NUM000-NUM005 (see ``rules.py``) run as a tier-1 gate
+via ``tests/test_numcheck.py`` / ``python -m tools.check`` and by
+hand::
+
+    python -m tools.numcheck [--update-baseline] [paths...]
+
+Shares the analyzer plumbing in ``tools/analysis_core.py`` (one AST
+parse per file per process, ``# numcheck: disable=NUMxxx -- why``
+suppressions, content-keyed baseline — committed EMPTY).  The
+declarative contract lives in ``reduction_registry.py`` (canonical
+reducers, sanctioned partition-independent contexts, fence helpers,
+compensation idioms) and ``tolerance_registry.py`` (every named
+comparison budget).  The RUNTIME half is the ulp contract
+(``lightgbm_tpu/obs/num_contract.py``, ``LGBM_TPU_NUM_CONTRACT=1``)
+and the cross-partition identity harness
+(``tools/identity_check.py``); this package only analyzes source.
+"""
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from tools.analysis_core import (FileInfo, Finding, discover_files,
+                                 load_baseline, new_findings, suppressed,
+                                 write_baseline)
+
+from .rules import FILE_RULES, PROJECT_RULES, RULE_TITLES, build_context
+
+BASELINE_DEFAULT = os.path.join("tools", "numcheck", "baseline.json")
+
+__all__ = [
+    "run_numcheck", "Finding", "RULE_TITLES", "load_baseline",
+    "write_baseline", "new_findings", "BASELINE_DEFAULT",
+]
+
+
+def run_numcheck(paths: Sequence[str] = ("lightgbm_tpu",),
+                 root: Optional[str] = None,
+                 project_rules: bool = True,
+                 ) -> Tuple[List[Finding], Dict[str, FileInfo]]:
+    """Analyze ``paths``; returns (findings sorted by location, FileInfo
+    by relative path).  Inline suppressions applied; the baseline is NOT
+    — callers diff via :func:`new_findings` (same contract as the other
+    five analyzers).  ``project_rules=False`` skips the registry-
+    soundness project rule for fixture runs.  Analyzer-fixture
+    directories (``*_fixtures``) are skipped: their files are
+    deliberate hazards for OTHER analyzers' tests and would flood the
+    tolerance sweep when numcheck covers ``tests/``."""
+    root = os.path.abspath(root or os.getcwd())
+    files = [fi for fi in discover_files(paths, root)
+             if "_fixtures" not in os.path.dirname(fi.rel)]
+    ctx = build_context(files, root, project_rules=project_rules)
+    findings: List[Finding] = []
+    for fi in files:
+        for rule in FILE_RULES:
+            for f in rule(fi, ctx):
+                if not suppressed(fi, f):
+                    findings.append(f)
+    if project_rules:
+        for rule in PROJECT_RULES:
+            findings.extend(rule(ctx))
+    findings.sort(key=lambda f: (f.file, f.line, f.rule))
+    return findings, ctx.by_rel

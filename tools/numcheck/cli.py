@@ -1,0 +1,107 @@
+"""numcheck CLI: ``python -m tools.numcheck [options] [paths...]``.
+
+Exit codes mirror the other analyzers: 0 = clean vs baseline, 1 = new
+findings, 2 = usage error.  Output is ``file:line: RULE message``.
+"""
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional
+
+from . import (BASELINE_DEFAULT, load_baseline, new_findings,
+               run_numcheck, write_baseline)
+
+
+def _dump_registry() -> int:
+    """Human-readable dump of the numeric ground truth: canonical
+    reducers, sanctioned raw-reduction contexts, fence contexts, and
+    the named tolerance table (mirrors concheck --lockgraph)."""
+    from . import reduction_registry as reg
+    from . import tolerance_registry as tols
+    print("canonical reducers (order-pinned reduction discipline):")
+    for r in reg.REDUCERS:
+        print(f"  {r['module']}::{r['name']}\n      {r['why']}")
+    print("sanctioned raw-reduction contexts (partition-independent):")
+    for c in reg.CONTEXTS:
+        print(f"  {c['module']}::{c['function']}\n      {c['why']}")
+    print("fenced score-update contexts:")
+    for c in reg.FENCE_CONTEXTS:
+        print(f"  {c['module']}::{c['function']}\n      {c['why']}")
+    print(f"psum combine seams: {', '.join(sorted(reg.PSUM_FUNCS))}")
+    print(f"tolerances ({len(tols.TOLERANCES)} named budgets):")
+    width = max(len(n) for n in tols.TOLERANCES)
+    for name, row in tols.TOLERANCES.items():
+        print(f"  {name:<{width}}  {row['value']:<8g} {row['unit']:<8}"
+              f" {row['contract']}")
+    return 0
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m tools.numcheck",
+        description="numeric-reproducibility analyzer for lightgbm_tpu "
+                    "(rules NUM000-NUM005; see README 'Static "
+                    "analysis')")
+    parser.add_argument("paths", nargs="*", default=["lightgbm_tpu"],
+                        help="files/directories to analyze "
+                             "(default: lightgbm_tpu)")
+    parser.add_argument("--root", default=None,
+                        help="project root (default: cwd)")
+    parser.add_argument("--baseline", default=None, metavar="FILE",
+                        help=f"baseline file (default: {BASELINE_DEFAULT} "
+                             f"under --root)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, pinned or not")
+    parser.add_argument("--update-baseline", action="store_true",
+                        help="rewrite the baseline to pin the current "
+                             "findings, then exit 0")
+    parser.add_argument("--no-project-rules", action="store_true",
+                        help="skip the registry-soundness project rule")
+    parser.add_argument("--registry", action="store_true",
+                        help="dump the sanctioned-reduction contexts and "
+                             "the named tolerance table, then exit 0")
+    args = parser.parse_args(argv)
+
+    if args.registry:
+        return _dump_registry()
+
+    root = os.path.abspath(args.root or os.getcwd())
+    baseline_path = (os.path.abspath(args.baseline) if args.baseline
+                     else os.path.join(root, BASELINE_DEFAULT))
+    try:
+        findings, by_rel = run_numcheck(
+            args.paths or ["lightgbm_tpu"], root=root,
+            project_rules=not args.no_project_rules)
+    except OSError as exc:
+        print(f"numcheck: {exc}", file=sys.stderr)
+        return 2
+
+    if args.update_baseline:
+        write_baseline(baseline_path, findings, by_rel,
+                       tool="tools.numcheck")
+        print(f"numcheck: baseline updated with {len(findings)} "
+              f"finding(s) at {os.path.relpath(baseline_path, root)}")
+        return 0
+
+    baseline = ({} if args.no_baseline
+                else load_baseline(baseline_path))
+    fresh = new_findings(findings, by_rel, baseline)
+    for f in fresh:
+        print(f.render())
+    pinned = len(findings) - len(fresh)
+    if fresh:
+        print(f"numcheck: {len(fresh)} new finding(s)"
+              + (f" ({pinned} baselined)" if pinned else "")
+              + "; fix them, suppress with justification "
+                "(# numcheck: disable=NUMxxx -- why), or refresh the "
+                "baseline with --update-baseline")
+        return 1
+    print(f"numcheck: clean ({pinned} baselined finding(s), "
+          f"{len(by_rel)} file(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,428 @@
+"""numcheck rules: NUM000-NUM005 — floating-point reproducibility
+discipline, statically.
+
+The sixth wall.  Five analyzers guard host-syncs, schedules, HBM, RNG,
+and locks; every one of them silently assumes the floating-point layer
+underneath is partition-invariant — and PR 14 proved by counterexample
+that a single raw ``jnp.sum`` on persistent state can break the
+byte-identity contract without tripping any of them.  numcheck pins
+that lesson as rules, with the same philosophy as the other walls:
+coarse name-based resolution, a declarative registry as ground truth
+(``reduction_registry.py`` + ``tolerance_registry.py``), and the rare
+over-taint handled by an inline ``# numcheck: disable=NUMxxx -- why``,
+never by a baseline entry.
+
+Rules:
+
+* **NUM000** — registry inconsistency: a sanctioned reducer/context
+  naming a module or function that does not exist, an entry with no
+  justification, or a malformed tolerance row.
+* **NUM001** — reassociation-unsafe reduction: ``jnp.sum``/``mean``/
+  ``dot`` (or the ``.sum()`` method form) over arrays whose names flow
+  from persistent training state (grad/hess/scores/hist families) in a
+  jax-importing module, outside a registered canonical reducer or
+  sanctioned partition-independent context.  XLA's ``reduce`` order is
+  implementation-defined and varies with the surrounding program — the
+  exact PR 14 bug class.
+* **NUM002** — uncompensated wide-to-narrow accumulation: a cast to
+  f32 whose operand derives from f64 (names/dtypes marked 64) without
+  a registered compensation idiom (Neumaier residual / hi-lo split).
+* **NUM003** — float ``==``/``!=`` on score/metric/gain-flavored
+  operands outside the registered exact-identity contexts (digest /
+  byte / model-text comparisons are the contract and stay sanctioned).
+* **NUM004** — unregistered tolerance: an ``atol=``/``rtol=``/
+  envelope-margin numeric literal that resolves to no row of
+  ``tolerance_registry.py`` — by name for migrated call sites
+  (``tol("f32_accum")``), by value for the long tail.
+* **NUM005** — unfenced mul+add update of registered fenced score
+  state (``scores = scores + lr * x``) outside the PR 11/14 fence
+  helpers: the shape XLA contracts into FMAs with fusion-context-
+  dependent last-ulp rounding (the lesson the optimization-barrier +
+  scale-then-gather discipline exists for).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from dataclasses import dataclass, field
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from tools.analysis_core import FileInfo, Finding
+
+from . import reduction_registry as reg
+from . import tolerance_registry as tolreg
+
+RULE_TITLES = {
+    "NUM000": "numeric registry inconsistency",
+    "NUM001": "reassociation-unsafe reduction on persistent state",
+    "NUM002": "uncompensated wide-to-narrow accumulation",
+    "NUM003": "float equality outside exact-identity contexts",
+    "NUM004": "unregistered tolerance literal",
+    "NUM005": "unfenced mul+add update of fenced score state",
+}
+
+_REDUCE_ATTRS = {"sum", "mean", "dot"}
+_TOL_KEYWORDS = {"atol", "rtol", "rel_margin", "abs_margin",
+                 "value_margin"}
+_REDUCE_MODULES = {"jnp", "np", "numpy", "jax"}
+
+
+# ---------------------------------------------------------------------------
+# context
+# ---------------------------------------------------------------------------
+@dataclass
+class NumContext:
+    root: str
+    files: List[FileInfo]
+    by_rel: Dict[str, FileInfo]
+    project_rules: bool
+    # (module rel, function name) -> justification, from the registry
+    sanctioned: Dict[Tuple[str, str], str] = field(default_factory=dict)
+    # rel -> set of function names defined anywhere in the file
+    defined_funcs: Dict[str, Set[str]] = field(default_factory=dict)
+
+
+def build_context(files: Sequence[FileInfo], root: str,
+                  project_rules: bool = True) -> NumContext:
+    ctx = NumContext(root=root, files=list(files),
+                     by_rel={fi.rel: fi for fi in files},
+                     project_rules=project_rules,
+                     sanctioned=reg.context_index())
+    for fi in files:
+        names: Set[str] = set()
+        for node in ast.walk(fi.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                names.add(node.name)
+        ctx.defined_funcs[fi.rel] = names
+    return ctx
+
+
+def _is_test_file(fi: FileInfo) -> bool:
+    return (fi.basename.startswith("test_")
+            or fi.rel.startswith("tests/") or "/tests/" in fi.rel)
+
+
+def _module_matches(rel: str, module: str) -> bool:
+    return rel == module or rel.endswith("/" + module)
+
+
+def _sanctioned_here(ctx: NumContext, fi: FileInfo,
+                     func_stack: Sequence[str]) -> Optional[str]:
+    """The justification if ANY enclosing function is registered for
+    this module, else None."""
+    for (module, func), why in ctx.sanctioned.items():
+        if func in func_stack and _module_matches(fi.rel, module):
+            return why
+    return None
+
+
+# ---------------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------------
+def _names_in(node: ast.AST) -> Set[str]:
+    """Every Name id and Attribute attr in a subtree — the coarse
+    name-flow the walls share."""
+    out: Set[str] = set()
+    for n in ast.walk(node):
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+_INT_VALUED = {"len", "argmax", "argmin", "argsort", "searchsorted"}
+
+
+def _names_for_float_flavor(node: ast.AST) -> Set[str]:
+    """Names in a comparison operand, EXCLUDING subtrees under
+    int-valued calls (``len(scores)`` compares a length, not a
+    float)."""
+    out: Set[str] = set()
+    skip: Set[int] = set()
+    for n in ast.walk(node):
+        if id(n) in skip:
+            skip.update(id(c) for c in ast.iter_child_nodes(n))
+            continue
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Name) \
+                and n.func.id in _INT_VALUED:
+            skip.update(id(c) for c in ast.iter_child_nodes(n))
+            continue
+        if isinstance(n, ast.Call) and isinstance(n.func, ast.Attribute) \
+                and n.func.attr in _INT_VALUED:
+            skip.update(id(c) for c in ast.iter_child_nodes(n))
+            continue
+        if isinstance(n, ast.Name):
+            out.add(n.id)
+        elif isinstance(n, ast.Attribute):
+            out.add(n.attr)
+    return out
+
+
+def _state_taint(names: Iterable[str]) -> Optional[str]:
+    for name in sorted(names):
+        if name in reg.STATE_EXACT:
+            return name
+        low = name.lower()
+        if any(sub in low for sub in reg.STATE_SUBSTRINGS):
+            return name
+    return None
+
+
+def _has_marker(names: Iterable[str], substrings: Sequence[str]) -> bool:
+    return any(sub in name.lower()
+               for name in names for sub in substrings)
+
+
+def _mentions_f64(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.Attribute) and "64" in n.attr:
+            return True
+        if isinstance(n, ast.Name) and "64" in n.id:
+            return True
+        if isinstance(n, ast.Constant) and n.value in ("float64", "f64"):
+            return True
+    return False
+
+
+def _contains_mul_add(node: ast.AST) -> bool:
+    for n in ast.walk(node):
+        if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Add):
+            for side in (n.left, n.right):
+                for m in ast.walk(side):
+                    if (isinstance(m, ast.BinOp)
+                            and isinstance(m.op, ast.Mult)):
+                        return True
+    return False
+
+
+class _Walker(ast.NodeVisitor):
+    """One pass per file carrying the enclosing-function stack."""
+
+    def __init__(self, fi: FileInfo, ctx: NumContext):
+        self.fi = fi
+        self.ctx = ctx
+        self.stack: List[str] = []
+        self.findings: List[Finding] = []
+        self.is_test = _is_test_file(fi)
+        self.traced = fi.imports_jax()
+
+    # -- plumbing ---------------------------------------------------------
+    def _emit(self, node: ast.AST, rule: str, message: str) -> None:
+        self.findings.append(Finding(self.fi.rel, node.lineno, rule,
+                                     message))
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.stack.append(node.name)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    # -- NUM001 / NUM002 / NUM004 -----------------------------------------
+    def visit_Call(self, node: ast.Call) -> None:
+        self._check_tolerance(node)
+        if not self.is_test:
+            self._check_reduction(node)
+            self._check_narrowing(node)
+        self.generic_visit(node)
+
+    def _check_reduction(self, node: ast.Call) -> None:
+        if not self.traced:
+            return
+        func = node.func
+        if not (isinstance(func, ast.Attribute)
+                and func.attr in _REDUCE_ATTRS):
+            return
+        if func.attr in reg.PSUM_FUNCS:
+            return
+        if isinstance(func.value, ast.Name) \
+                and func.value.id in _REDUCE_MODULES:
+            # module form: jnp.sum(x, ...) — taint from the arguments
+            operands: List[ast.AST] = list(node.args) \
+                + [kw.value for kw in node.keywords if kw.value is not None]
+        else:
+            # method form: x.sum() — taint from the receiver + args
+            operands = [func.value] + list(node.args)
+        names: Set[str] = set()
+        for op in operands:
+            names |= _names_in(op)
+        taint = _state_taint(names)
+        if taint is None:
+            return
+        if _sanctioned_here(self.ctx, self.fi, self.stack) is not None:
+            return
+        self._emit(node, "NUM001",
+                   f"reassociation-unsafe reduction '{func.attr}' over "
+                   f"persistent f32 state ('{taint}') in traced code: "
+                   f"XLA reduce order is implementation-defined and "
+                   f"partition-dependent — use a canonical reducer "
+                   f"(learner/serial.py root_stats family) or register "
+                   f"the site in tools/numcheck/reduction_registry.py")
+
+    def _check_narrowing(self, node: ast.Call) -> None:
+        func = node.func
+        inner: Optional[ast.AST] = None
+        if isinstance(func, ast.Attribute) and func.attr == "astype" \
+                and node.args:
+            arg_names = _names_in(node.args[0])
+            if "float32" in arg_names or any(
+                    isinstance(a, ast.Constant)
+                    and a.value in ("float32", "f32")
+                    for a in node.args):
+                inner = func.value
+        elif isinstance(func, ast.Attribute) and func.attr == "float32" \
+                and len(node.args) == 1:
+            inner = node.args[0]
+        if inner is None or not _mentions_f64(inner):
+            return
+        if _sanctioned_here(self.ctx, self.fi, self.stack) is not None:
+            return
+        self._emit(node, "NUM002",
+                   "uncompensated wide-to-narrow accumulation: an f64-"
+                   "derived value is cast to f32 with no registered "
+                   "compensation idiom (Neumaier residual / hi-lo "
+                   "split) — precision silently dropped; see "
+                   "tools/numcheck/reduction_registry.py COMPENSATED")
+
+    def _check_tolerance(self, node: ast.Call) -> None:
+        for kw in node.keywords:
+            if kw.arg not in _TOL_KEYWORDS:
+                continue
+            v = kw.value
+            if not (isinstance(v, ast.Constant)
+                    and isinstance(v.value, (int, float))
+                    and not isinstance(v.value, bool)):
+                continue
+            if float(v.value) in tolreg.registered_values():
+                continue
+            self._emit(v, "NUM004",
+                       f"unregistered tolerance literal "
+                       f"{kw.arg}={v.value!r}: every comparison budget "
+                       f"must resolve to a named row of tools/numcheck/"
+                       f"tolerance_registry.py — use tol('<id>') or "
+                       f"add a justified entry")
+
+    # -- NUM003 -----------------------------------------------------------
+    def visit_Compare(self, node: ast.Compare) -> None:
+        if not self.is_test and any(
+                isinstance(op, (ast.Eq, ast.NotEq)) for op in node.ops):
+            operands = [node.left] + list(node.comparators)
+            names: Set[str] = set()
+            for op in operands:
+                names |= _names_for_float_flavor(op)
+            if _has_marker(names, reg.FLOAT_EQ_SUBSTRINGS) \
+                    and not _has_marker(names,
+                                        reg.EXACT_IDENTITY_SUBSTRINGS):
+                self._emit(node, "NUM003",
+                           "float == / != on score/metric-flavored "
+                           "state: exact float comparison is only "
+                           "sound for digest/byte identity — compare "
+                           "digests, or use a registered tolerance "
+                           "(tools/numcheck/tolerance_registry.py)")
+        self.generic_visit(node)
+
+    # -- NUM005 -----------------------------------------------------------
+    def _fenced_target(self, target: ast.AST) -> Optional[str]:
+        if isinstance(target, ast.Name) and target.id in reg.FENCED_STATE:
+            return target.id
+        if isinstance(target, ast.Attribute) \
+                and target.attr in reg.FENCED_STATE:
+            return target.attr
+        return None
+
+    def _check_fence(self, node: ast.AST, targets: Sequence[ast.AST],
+                     value: ast.AST, aug_add: bool = False) -> None:
+        if self.is_test or not self.traced:
+            return
+        name = next((n for n in map(self._fenced_target, targets) if n),
+                    None)
+        if name is None:
+            return
+        hazard = (_contains_mul_add(value) if not aug_add
+                  else any(isinstance(m, ast.BinOp)
+                           and isinstance(m.op, ast.Mult)
+                           for m in ast.walk(value)))
+        if not hazard:
+            return
+        if _sanctioned_here(self.ctx, self.fi, self.stack) is not None:
+            return
+        self._emit(node, "NUM005",
+                   f"unfenced mul+add update of fenced state '{name}': "
+                   f"XLA contracts producer/consumer mul+add chains "
+                   f"into FMAs with fusion-dependent last-ulp rounding "
+                   f"— use the fence discipline (optimization_barrier "
+                   f"+ pre-scaled .at[].add; see reduction_registry."
+                   f"FENCE_CONTEXTS)")
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._check_fence(node, node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        if isinstance(node.op, ast.Add):
+            self._check_fence(node, [node.target], node.value,
+                              aug_add=True)
+        self.generic_visit(node)
+
+
+# ---------------------------------------------------------------------------
+# file rules
+# ---------------------------------------------------------------------------
+def rule_file_walk(fi: FileInfo, ctx: NumContext) -> List[Finding]:
+    w = _Walker(fi, ctx)
+    w.visit(fi.tree)
+    return w.findings
+
+
+FILE_RULES = (rule_file_walk,)
+
+
+# ---------------------------------------------------------------------------
+# project rule: NUM000 registry soundness
+# ---------------------------------------------------------------------------
+_REG_REL = "tools/numcheck/reduction_registry.py"
+_TOL_REL = "tools/numcheck/tolerance_registry.py"
+
+
+def rule_registry_sound(ctx: NumContext) -> List[Finding]:
+    out: List[Finding] = []
+
+    def bad(rel: str, msg: str) -> None:
+        out.append(Finding(rel, 1, "NUM000", msg))
+
+    for table, kind in ((reg.REDUCERS, "reducer"),
+                        (reg.CONTEXTS, "context"),
+                        (reg.FENCE_CONTEXTS, "fence context"),
+                        (reg.COMPENSATED, "compensation idiom")):
+        for d in table:
+            func = d.get("function") or d.get("name")
+            module = d.get("module", "")
+            if not func or not module:
+                bad(_REG_REL, f"{kind} entry {d!r} missing "
+                              f"function/module")
+                continue
+            if not d.get("why", "").strip():
+                bad(_REG_REL, f"{kind} '{func}' has no justification")
+            path = os.path.join(ctx.root, module)
+            analyzed = [rel for rel in ctx.defined_funcs
+                        if _module_matches(rel, module)]
+            if analyzed:
+                if not any(func in ctx.defined_funcs[rel]
+                           for rel in analyzed):
+                    bad(_REG_REL,
+                        f"{kind} '{func}' is not defined in {module}: "
+                        f"the registry drifted from the code")
+            elif not os.path.exists(path):
+                bad(_REG_REL, f"{kind} '{func}' names missing module "
+                              f"{module}")
+    for name, row in tolreg.TOLERANCES.items():
+        if not isinstance(row.get("value"), (int, float)):
+            bad(_TOL_REL, f"tolerance '{name}' has a non-numeric value")
+        for key in ("why", "contract", "unit"):
+            if not str(row.get(key, "")).strip():
+                bad(_TOL_REL, f"tolerance '{name}' missing '{key}'")
+    return out
+
+
+PROJECT_RULES = (rule_registry_sound,)

@@ -1,0 +1,147 @@
+"""Declarative tolerance registry — every numeric comparison budget in
+the test suite and the runtime numerics contract, as NAMED rows.
+
+Before PR 19 ~70 ad-hoc ``atol=``/``rtol=`` magic constants sat in 20
+test files with no owner; a tolerance is a CLAIM about how much two
+computations may legally disagree, and an unowned claim decays into
+"whatever makes the test pass".  Each row here carries the value, the
+justification, and the owning parity contract.  The static half
+(NUM004) requires every tolerance literal in a test to resolve to a
+registered row — by name (``tol("f32_accum")``) for the migrated
+files, by value for the long tail — so new magic constants cannot
+land without a registry entry saying why.
+
+The runtime half shares rows by NAME, the same way concheck's lock
+registry shares names with ``obs/lock_contract.py``:
+``obs/num_contract.py``'s ulp budgets and ``parallel/envelope.py``'s
+margins must equal the rows declared here (``tests/test_numcheck.py``
+pins the coherence).
+"""
+from __future__ import annotations
+
+# id -> {value, unit, why, contract}
+TOLERANCES = {
+    # -- exact / byte-identity --------------------------------------------
+    "exact": {
+        "value": 0.0, "unit": "abs",
+        "why": "bitwise agreement asserted through the allclose shape",
+        "contract": "byte-identity (PR 11/14): partitionings agree "
+                    "exactly, not approximately"},
+    # -- f64 oracle comparisons -------------------------------------------
+    "f64_solver": {
+        "value": 1e-12, "unit": "rel",
+        "why": "f64 computation vs an f64 closed-form oracle: only "
+               "rounding of the final few ops",
+        "contract": "ops oracles (tools/tpulint oracle docstrings)"},
+    "f64_chain": {
+        "value": 1e-9, "unit": "abs",
+        "why": "longer f64 chains (leaf output, gain algebra) vs a "
+               "NumPy f64 re-derivation",
+        "contract": "ops oracles"},
+    # -- f32 agreement ladders --------------------------------------------
+    "f32_ulp_few": {
+        "value": 1e-7, "unit": "abs",
+        "why": "a few f32 ulps at unit scale: same math, different "
+               "fusion context",
+        "contract": "kernel parity (ops/)"},
+    "f32_tight": {
+        "value": 1e-6, "unit": "abs",
+        "why": "~10 f32 ulps at unit scale: identical algorithm, "
+               "reordered elementwise ops",
+        "contract": "predict/save-load parity"},
+    "f32_eps_few": {
+        "value": 3e-6, "unit": "abs",
+        "why": "tens of f32 ulps: short accumulation chains in a "
+               "different order",
+        "contract": "kernel parity (ops/)"},
+    "f32_accum": {
+        "value": 1e-5, "unit": "abs+rel",
+        "why": "different-order f32 accumulation at unit scale (the "
+               "reference's own cross-thread histogram envelope)",
+        "contract": "histogram/predict parity"},
+    "f32_accum_2x": {
+        "value": 2e-5, "unit": "abs",
+        "why": "two stacked f32 accumulation stages (device program "
+               "vs host oracle, each with its own rounding)",
+        "contract": "serve device-vs-host parity (serve/compiler.py)"},
+    "f32_accum_5x": {
+        "value": 5e-5, "unit": "abs",
+        "why": "text round-trip (17 sig digits) + device re-"
+               "accumulation stacked",
+        "contract": "model text round-trip parity"},
+    "f32_sum_wide": {
+        "value": 1e-4, "unit": "abs+rel",
+        "why": "wide f32 reductions (gains over many bins, SHAP "
+               "contribution sums) in different orders",
+        "contract": "split-finder / contribution parity"},
+    "f32_rel_wide": {
+        "value": 2e-4, "unit": "rel",
+        "why": "relative form of the wide-reduction envelope for "
+               "quantities far from unit scale",
+        "contract": "split-finder parity"},
+    "f32_wide_5x": {
+        "value": 5e-4, "unit": "abs",
+        "why": "bf16-assisted kernels (hilo histogram modes) vs f32 "
+               "reference",
+        "contract": "pallas kernel parity (ops/pallas_histogram.py)"},
+    "metric_coarse": {
+        "value": 1e-3, "unit": "abs+rel",
+        "why": "end-to-end metric agreement after independently-"
+               "rounded training paths",
+        "contract": "engine/consistency parity"},
+    "prob_coarse": {
+        "value": 1e-2, "unit": "abs",
+        "why": "probability-level agreement between structurally "
+               "different but statistically equivalent models",
+        "contract": "engine/consistency parity"},
+    # -- the measured envelope (PR 4/8) -----------------------------------
+    "envelope_value_noise": {
+        "value": 0.0104, "unit": "abs",
+        "why": "MEASURED serial-path leaf-value noise from f32 "
+               "histogram accumulation order (parallel/envelope.py "
+               "calibration run)",
+        "contract": "model flip envelope (parallel/envelope.py "
+                    "value_margin calibration)"},
+    "envelope_rel": {
+        "value": 0.05, "unit": "rel",
+        "why": "near-tie margin: a flipped split pair only counts as "
+               "divergence when its gain gap clears 5% of the larger "
+               "gain",
+        "contract": "model flip envelope (parallel/envelope.py "
+                    "rel_margin; PR 4/8)"},
+    "envelope_abs": {
+        "value": 0.5, "unit": "abs",
+        "why": "absolute gain floor for the near-zero-gain noise "
+               "regime of the flip envelope",
+        "contract": "model flip envelope (parallel/envelope.py "
+                    "abs_margin; PR 4/8)"},
+    # -- ulp budgets (shared with the runtime contract) --------------------
+    "serve_ulp": {
+        "value": 1, "unit": "ulp",
+        "why": "serve scores within 1 f32 ulp of the f64 sequential "
+               "tree-accumulation oracle (hi/lo compensated adds)",
+        "contract": "serve parity (serve/compiler.py, PR 13)"},
+    "score_root_ulp": {
+        "value": 8, "unit": "ulp",
+        "why": "per-window canonical f32 score root-sum vs the f64 "
+               "host oracle: the pairwise tree loses < log2(chunks) "
+               "ulps; 8 bounds every tier-1 workload with margin while "
+               "a reassociated (partition-dependent) reduction drifts "
+               "orders of magnitude past it",
+        "contract": "runtime ulp contract (obs/num_contract.py, "
+                    "LGBM_TPU_NUM_CONTRACT=1)"},
+}
+
+
+def tol(name):
+    """The registered tolerance value for ``name`` (tests call this
+    instead of writing magic constants; NUM004 enforces it)."""
+    return TOLERANCES[name]["value"]
+
+
+def registered_values():
+    """Every registered numeric value, for NUM004's by-value resolution
+    of the unmigrated long tail (plus 0/exact in int form)."""
+    vals = {float(d["value"]) for d in TOLERANCES.values()}
+    vals.add(0.0)
+    return vals
