@@ -1160,7 +1160,12 @@ STREAM_SCHEMA_KEYS = (
     "stream_rows", "stream_block_rows", "stream_shards", "stream_iters",
     "stream_ingest_rows_per_sec", "stream_row_iters_per_sec",
     "stream_identity_ok", "stream_resume_ok",
-    "stream_host_rss_peak_bytes", "stream_model_digest")
+    "stream_host_rss_peak_bytes", "stream_model_digest",
+    # ISSUE 20: the resolved histogram backend the scale phase streamed
+    # on, the ledger-tracked rows/s, and the two A/B verdicts (seeded
+    # kernel folds vs forced scatter; pipeline vs serial escape hatch)
+    "stream_backend", "stream_rows_per_sec",
+    "stream_kernel_speedup", "stream_pipeline_speedup")
 
 
 def stream_ingest_leg(line=None, dryrun: bool = False):
@@ -1287,12 +1292,60 @@ def stream_ingest_leg(line=None, dryrun: bool = False):
         wall = time.time() - t0
         out["stream_train_s"] = round(wall, 3)
         out["stream_row_iters_per_sec"] = round(rows * iters / wall, 1)
+        # the perf-ledger row (tools/perf_ledger.py): streamed train
+        # throughput at the scale shape, and the RESOLVED histogram
+        # backend it rode (kernel folds on TPU, scatter on CPU)
+        out["stream_rows_per_sec"] = out["stream_row_iters_per_sec"]
+        out["stream_backend"] = tr.backend
         out["stream_model_digest"] = bst.digest(include_scores=False)
         # host memory wall: process peak RSS (lifetime watermark — at
         # 100M rows the streamed state is scores+grad+hess ≈ 12 bytes/
         # row host-side, and the mmap'd store pages stay evictable)
         out["stream_host_rss_peak_bytes"] = \
             resource.getrusage(resource.RUSAGE_SELF).ru_maxrss * 1024
+        _partial("stream-scale")
+
+        # 4) A/B phase (ISSUE 20): seeded-kernel folds vs forced
+        # scatter, and the upload/compute pipeline vs the serial
+        # escape hatch.  Both sides ride the platform's DEFAULT
+        # backend resolution — on TPU the kernel leg streams through
+        # the seeded Pallas/compact folds; on CPU (dryrun) both sides
+        # resolve to scatter and the kernel speedup sits at ~1.0 (the
+        # schema gate checks presence and sanity, not CPU throughput).
+        ab_rows = 2 * block if toy else int(
+            os.environ.get("BENCH_STREAM_AB_ROWS", 4 << 20))
+        ab_iters = 1 if toy else iters
+        ab = oc.ingest_synthetic(os.path.join(tmp, "ab"), ab_rows, f,
+                                 cfg, seed=3, shard_rows=ab_rows)
+
+        def _ab_train(backend, pipeline):
+            envs = {"LGBM_TPU_STREAM_PIPELINE": pipeline}
+            if backend is not None:
+                envs["LGBM_TPU_HIST_BACKEND"] = backend
+            old = {k: os.environ.get(k) for k in envs}
+            os.environ.update(envs)
+            try:
+                abtr = StreamTrainer(cfg, ab, block_rows=block)
+                ta = time.time()
+                abtr.train(ab_iters)
+                return time.time() - ta
+            finally:
+                for k, v in old.items():
+                    if v is None:
+                        os.environ.pop(k, None)
+                    else:
+                        os.environ[k] = v
+
+        t_default = _ab_train(None, "1")
+        # toy/CPU: the default already resolves to scatter, so the
+        # forced-scatter leg would retrain the identical program —
+        # skip it and record the exact ratio 1.0
+        t_scatter = t_default if toy else _ab_train("scatter", "1")
+        t_serial = _ab_train(None, "0")
+        out["stream_kernel_speedup"] = round(
+            t_scatter / max(t_default, 1e-9), 3)
+        out["stream_pipeline_speedup"] = round(
+            t_serial / max(t_default, 1e-9), 3)
     finally:
         shutil.rmtree(tmp, ignore_errors=True)
     return out

@@ -12,20 +12,27 @@ watermark proves it).  Scores, gradients and hessians are host-
 resident and updated per block as the blocks stream.
 
 **Byte-identity contract** (the DET005 seam ``LGBM_TPU_STREAM_ROWS``,
-pinned by tests/test_streaming.py): on the exact-accumulation scatter
-histogram backend (the default off-TPU), streamed training is
+pinned by tests/test_streaming.py): streamed training is
 BYTE-IDENTICAL — model text and score digests via ``Booster.digest()``
 — to in-memory ``lgb.train`` on the same data, serial AND 2-shard
-data-parallel.  Three mechanisms make that possible:
+data-parallel, on ALL THREE histogram backends.  Three mechanisms:
 
-1. **Carried-accumulator scatter folds.**  XLA applies same-location
-   scatter-add updates in row order, so folding per-block scatters
-   into a carried ``[A, F, B, 3]`` accumulator reproduces the
-   monolithic ``hist_active_scatter`` bitwise; the parity test is the
-   gate.  On the Pallas/compact kernels (TPU) the per-block partials
-   are ADDED instead (through the shared ``make_hist_fn`` seam) — the
-   documented last-ulp class — so the on-device identity gate pins the
-   scatter path while the throughput leg rides the kernels.
+1. **Carried-accumulator folds.**  On the scatter backend, XLA applies
+   same-location scatter-add updates in row order, so folding per-block
+   scatters into a carried f32 ``[A, F, B, 3]`` accumulator reproduces
+   the monolithic ``hist_active_scatter`` bitwise.  On the
+   Pallas/compact kernels the fold carries the RAW kernel accumulator
+   instead (``learner.serial.make_hist_fold_fn``): each block's kernel
+   call SEEDS its output from the carry via ``input_output_aliases``
+   (the ``@pl.when`` zero-init becomes a seed-load), so a chain of
+   per-block calls replays the monolithic kernel's adds in the
+   monolithic order — exactly int32 on the quantized default modes
+   (per-tree global quantization scales are host-derived over every
+   block, :func:`_fold_scales`), same-order f32 on the wide float
+   modes.  The raw carry is dequantized/unpacked ONCE per wave, by the
+   same jitted graph the in-memory kernels fuse in-call.  Float
+   COMPACT folds are the one chain-inexact case and degrade to the
+   wide kernel inside the fold seam.
 2. **Canonical chunked root statistics** (``learner/serial.py
    root_stats``): the resident ``_init_state`` derives the root sums
    from fixed ``STREAM_CHUNK``-sized chunk sums reduced by a fixed
@@ -36,6 +43,22 @@ data-parallel.  Three mechanisms make that possible:
    with the contraction-proof scale-then-gather shape (the PR 11 mesh
    discipline), so this module's standalone per-block programs compile
    to the same last-ulp rounding as the fused in-memory body.
+
+**The upload/compute pipeline** (``LGBM_TPU_STREAM_PIPELINE``, default
+on): the wave loop runs a bounded-depth-2 prefetch+staging pipeline —
+a single host staging thread reads block k+1 from the ShardStore mmap
+and pads it while block k's fold computes on device, and block k+1's
+``device_put`` is issued BEFORE block k's fold is awaited, so the
+host->device copy rides under kernel time instead of serializing with
+it.  Fold order never changes — the pipeline reorders only host
+staging work — so ``LGBM_TPU_STREAM_PIPELINE=0`` (the serial escape
+hatch) is byte-identical by construction; ``stream.prefetch`` /
+``stream.upload`` / ``stream.fold`` spans plus the
+``stream.pipeline.overlap_s`` counter prove the overlap instead of
+claiming it.  Uploads sit behind the shared retry policy with the
+``stream.upload`` fault point: a transient device fault is retried
+BEFORE the fold is dispatched, so a retried upload can never tear a
+fold.
 
 2-shard data-parallel composes by mirroring the mesh row partition
 (``parallel/mesh.py shard_row_ranges``): each shard's blocks fold into
@@ -73,6 +96,7 @@ from __future__ import annotations
 
 import hashlib
 import os
+import time
 from typing import List, Optional, Tuple
 
 import jax
@@ -84,9 +108,8 @@ from ..io.dataset import BinnedDataset, Metadata
 from ..io.device import DeviceData, feature_meta_np
 from ..learner.serial import (STREAM_CHUNK, BuiltTree, _WaveState,
                               _apply_wave, _empty_best, apply_hist_wave,
-                              make_hist_fn, reduce_chunk_sums,
-                              resolve_backend, root_chunk_sums, scan_grid,
-                              stage_plan, uses_pallas)
+                              make_hist_fold_fn, reduce_chunk_sums,
+                              root_chunk_sums, scan_grid, stage_plan)
 from ..obs import counter_add, event, span as obs_span
 from ..objective.objectives import create_objective
 from ..ops.pallas_histogram import bin_stride
@@ -110,6 +133,32 @@ def stream_rows() -> int:
     if r <= 0:
         return 0
     return -(-r // STREAM_CHUNK) * STREAM_CHUNK
+
+
+_SCALE_CHUNK = 1 << 24
+
+
+def _fold_scales(grad: np.ndarray, hess: np.ndarray) -> np.ndarray:
+    """Per-(tree, shard) global quantization scales for the seeded
+    kernel folds: ``[|g|max, |h|max]`` clamped to 1e-30, f32.
+
+    Every block of a shard must quantize against ONE scale pair or the
+    int8 codes (and therefore the int32 accumulator) stop being a pure
+    function of the data partition.  The in-memory kernels derive the
+    same scalars on device as ``max(|x|)`` over the shard's rows —
+    f32 absmax is exact and order-independent (no rounding, commutative
+    idempotent max), so this chunked host reduction lands the identical
+    bit pattern without paginating the full vector through HBM.
+    SANCTIONED REASSOCIATION CONTEXT (tools/numcheck): chunking reorders
+    only ``max``, never an add."""
+    out = np.empty(2, np.float32)
+    for i, arr in enumerate((grad, hess)):
+        m = np.float32(0.0)
+        for lo in range(0, arr.shape[0], _SCALE_CHUNK):
+            c = np.max(np.abs(arr[lo:lo + _SCALE_CHUNK]))
+            m = np.maximum(m, np.float32(c))
+        out[i] = np.maximum(m, np.float32(1e-30))
+    return out
 
 
 class _Source:
@@ -278,13 +327,27 @@ class StreamTrainer:
         _, self.A_tail = stage_plan(L, self.growth.wave_size)
         self.Bh = bin_stride(self.dd_meta.group_max_bins)
         from ..learner.serial import default_hist_mode, effective_hist_mode
+        # the hist mode keys on the GLOBAL row count, not the block
+        # size: quantized int32 accumulators bound on the total rows
+        # folded through them, and the in-memory model this trainer
+        # must equal bitwise keys its mode on n too
         self.hist_mode = effective_hist_mode(
-            config.hist_mode or default_hist_mode(), self.R)
-        self.backend = resolve_backend(self.dd_meta, L,
-                                       hist_mode=self.hist_mode)
-        # exact-accumulation contract on "scatter"; kernel backends fold
-        # per-block partials through the shared make_hist_fn seam
-        self._kernel_hist = uses_pallas(self.backend)
+            config.hist_mode or default_hist_mode(), n)
+        # kernel-exact folds: on the Pallas/compact backends every block
+        # call SEEDS the kernel accumulator from the carried raw grid
+        # (learner.serial.make_hist_fold_fn), so the streamed chain IS
+        # the monolithic kernel bitwise; None -> the exact scatter fold
+        self._fold = make_hist_fold_fn(
+            self.dd_meta, L, self.A_tail, self.R,
+            hist_mode=self.hist_mode, num_data=n)
+        self.backend = self._fold.backend if self._fold else "scatter"
+        self._kernel_hist = self._fold is not None
+        # bounded-depth-2 upload/compute pipeline (module docstring);
+        # "0"/"off" is the byte-identical serial escape hatch
+        self._pipeline_on = os.environ.get(
+            "LGBM_TPU_STREAM_PIPELINE", "1").strip().lower() not in (
+                "0", "off", "false")
+        self._stager = None
 
         # host score state [n, K] f32 — the training state that would
         # not fit in HBM; every update happens on device per block and
@@ -389,23 +452,21 @@ class StreamTrainer:
 
     def _wave_block_fn(self):
         """(bins, leaf2, best, pend_sel, pend_new, acc, grad, hess,
-        act_small) -> (leaf2', acc'): route the pending splits over this
-        block, then fold its active-leaf histograms into the carry."""
+        act_small, scales) -> (leaf2', acc'): route the pending splits
+        over this block, then fold its active-leaf histograms into the
+        carry — a SEEDED kernel call on the Pallas/compact backends
+        (raw carry; ``scales`` is the shard's fixed quantization pair),
+        the row-order scatter on the exact f32 path (``scales`` None)."""
         dd = self.dd_meta
-        kernel = self._kernel_hist
-        hist_mode = self.hist_mode
-        backend = self.backend
-        L = self.L
+        fold = self._fold
 
         def wave_block(bins, leaf2, best, pend_sel, pend_new, acc,
-                       grad, hess, act_small):
+                       grad, hess, act_small, scales):
             data = dd._replace(bins=bins)
             leaf2 = self._route(data, leaf2, best, pend_sel, pend_new)
-            if kernel:
-                hist_fn = make_hist_fn(data, grad, hess, L,
-                                       backend=backend,
-                                       hist_mode=hist_mode)
-                acc = acc + hist_fn(leaf2[1], act_small)
+            if fold is not None:
+                acc = fold.fold(bins, grad, hess, leaf2[1], act_small,
+                                acc, scales)
             else:
                 acc = self._hist_into(acc, data.bins, grad, hess,
                                       leaf2[1], act_small)
@@ -573,20 +634,24 @@ class StreamTrainer:
         # from it keeps the per-iteration seeds (feature_fraction keys
         # on the TRUE iteration index) on the uninterrupted schedule
         start = self.booster.iter
-        with obs_span("stream.train", rows=self.n, block=self.R,
-                      shards=self.S):
-            self._finish_recovery()
-            for it in range(start, iters):
-                stopped = self._train_one_iter(it)
+        try:
+            with obs_span("stream.train", rows=self.n, block=self.R,
+                          shards=self.S):
                 self._finish_recovery()
-                self._window_contracts(it + 1)
-                if stopped:
-                    break
-                if self.elastic is not None:
-                    # progress rides the heartbeats: operators (and the
-                    # chaos launcher's kill scheduler) see it in info()
-                    self.elastic.client.set_status(iteration=it + 1)
-                    self._maybe_barrier(it + 1)
+                for it in range(start, iters):
+                    stopped = self._train_one_iter(it)
+                    self._finish_recovery()
+                    self._window_contracts(it + 1)
+                    if stopped:
+                        break
+                    if self.elastic is not None:
+                        # progress rides the heartbeats: operators (and
+                        # the chaos launcher's kill scheduler) see it
+                        # in info()
+                        self.elastic.client.set_status(iteration=it + 1)
+                        self._maybe_barrier(it + 1)
+        finally:
+            self._close_stager()
         ep = self.recovery_episode
         if ep is not None:
             # early stop before the failure iteration came back around:
@@ -674,16 +739,50 @@ class StreamTrainer:
             return True
         return False
 
-    def _block_arrays(self, start: int, stop: int, m: int,
-                      grad: np.ndarray, hess: np.ndarray):
-        """One block's device uploads for a wave pass: bins + padded
-        grad/hess.  Re-uploaded per wave — HBM holds ONE block (plus
-        the XLA double-buffer in flight), never the dataset."""
-        bins, _, _ = self.src.read_rows(start, stop)
-        bins_d = jnp.asarray(self._pad_block(np.asarray(bins), m))
-        gb = self._pad_block(grad[start:stop], m)
-        hb = self._pad_block(hess[start:stop], m)
-        return bins_d, jnp.asarray(gb), jnp.asarray(hb)
+    # -- the upload/compute pipeline --------------------------------------
+    def _ensure_stager(self):
+        """The single host staging thread.  Depth is bounded at 2 by
+        construction: at most one block is staged ahead of the block
+        computing, so device residency is one extra block's uploads —
+        the footprint model (tools/memcheck shapes.json) charges it."""
+        if self._stager is None:
+            from concurrent.futures import ThreadPoolExecutor
+            self._stager = ThreadPoolExecutor(
+                max_workers=1, thread_name_prefix="stream-stage")
+        return self._stager
+
+    def _close_stager(self) -> None:
+        if self._stager is not None:
+            self._stager.shutdown(wait=True)
+            self._stager = None
+
+    def _stage_block(self, start: int, stop: int, m: int,
+                     grad: np.ndarray, hess: np.ndarray):
+        """Host staging of one block (ShardStore mmap read + pad): the
+        part of a block's turnaround that the pipeline moves onto the
+        prefetch thread while the previous block's fold computes."""
+        with obs_span("stream.prefetch", rows=m):
+            bins, _, _ = self.src.read_rows(start, stop)
+            return (self._pad_block(np.asarray(bins), m),
+                    self._pad_block(grad[start:stop], m),
+                    self._pad_block(hess[start:stop], m))
+
+    def _upload_block(self, staged):
+        """Device upload of a staged block behind the shared retry
+        policy (``stream.upload`` fault point): a transient device
+        fault retries the whole put BEFORE any fold is dispatched
+        against these arrays, so a retried upload can never tear a
+        fold."""
+        from ..utils.faults import fault_point
+        from ..utils.retry import retry_call
+        bins_h, gb, hb = staged
+
+        def put():
+            fault_point("stream.upload")
+            return (jnp.asarray(bins_h), jnp.asarray(gb),
+                    jnp.asarray(hb))
+        with obs_span("stream.upload", rows=int(bins_h.shape[0])):
+            return retry_call(put, what="stream.upload")
 
     def _build_streamed_tree(self, it: int, k: int, grad: np.ndarray,
                              hess: np.ndarray, fmask) -> int:
@@ -759,20 +858,70 @@ class StreamTrainer:
             tot = combine(parts)
             state = init_state(tot[:, None])   # [3, 1]: identity reduce
 
+        # per-(tree, shard) quantization scales for the kernel folds —
+        # fixed across blocks AND waves, host-derived over the shard's
+        # full row range (bitwise the device absmax the in-memory
+        # kernels compute; an empty shard range clamps to 1e-30 on both
+        # sides).  None on the float modes and the scatter path.
+        fold = self._fold
+        scales_dev = {}
+        if fold is not None and fold.quantized:
+            for s in self.owned:
+                lo, hi = self.ranges[s]
+                hi = min(hi, self.n)
+                scales_dev[s] = jnp.asarray(
+                    _fold_scales(grad[lo:hi], hess[lo:hi]))
+
+        pipelined = self._pipeline_on and len(blocks) > 1
+        stager = self._ensure_stager() if pipelined else None
+
+        def _staged(idx: int):
+            _, b_start, b_stop, b_m = blocks[idx]
+            return self._stage_block(b_start, b_stop, b_m, grad, hess)
+
         while True:
             if bool(state.done) or int(state.nl) >= L:
                 break
-            accs = [jnp.zeros((A, self.dd_meta.num_groups, self.Bh, 3),
+            # the wave carry: RAW kernel accumulators on the fold
+            # backends (seeded per block, unpacked once below), the f32
+            # grid on the exact scatter path
+            accs = [fold.init_acc() if fold is not None else
+                    jnp.zeros((A, self.dd_meta.num_groups, self.Bh, 3),
                               jnp.float32) for _ in range(self.S)]
+            dev = self._upload_block(_staged(0)) if blocks else None
             for bi, (s, start, stop, m) in enumerate(blocks):
-                bins_d, gd, hd = self._block_arrays(start, stop, m,
-                                                    grad, hess)
-                l2, acc = wave_block(
-                    bins_d, jnp.asarray(leaf2_host[bi]), state.best,
-                    state.pend_sel, state.pend_new, accs[s], gd, hd,
-                    state.act_small)
-                leaf2_host[bi] = np.asarray(l2)
+                bins_d, gd, hd = dev
+                dev = None
+                # depth-2 pipeline: hand block k+1 to the staging
+                # thread before dispatching block k's fold
+                fut = (stager.submit(_staged, bi + 1)
+                       if pipelined and bi + 1 < len(blocks) else None)
+                with obs_span("stream.fold", block=bi):
+                    l2, acc = wave_block(
+                        bins_d, jnp.asarray(leaf2_host[bi]), state.best,
+                        state.pend_sel, state.pend_new, accs[s], gd, hd,
+                        state.act_small, scales_dev.get(s))
                 accs[s] = acc
+                if fut is not None:
+                    # block k+1's staging wait + upload land here —
+                    # after block k's fold DISPATCH, before its await —
+                    # so the host->device copy rides under kernel time.
+                    # The counter is the proof of overlap, not a claim.
+                    t0 = time.perf_counter()
+                    dev = self._upload_block(fut.result())
+                    counter_add("stream.pipeline.overlap_s",
+                                time.perf_counter() - t0)
+                leaf2_host[bi] = np.asarray(l2)     # the fold await
+                if dev is None and bi + 1 < len(blocks):
+                    # serial escape hatch: stage + upload only after
+                    # the fold is awaited (the reference schedule)
+                    dev = self._upload_block(_staged(bi + 1))
+            if fold is not None:
+                # finalize each owned chain ONCE per wave — BEFORE the
+                # shard exchange/combine, so the elastic protocol moves
+                # the same f32 [A, F, B, 3] partials on every backend
+                for s in self.owned:
+                    accs[s] = fold.unpack(accs[s], scales_dev.get(s))
             if exchange:
                 # per-shard wave partials are rank-independent (each
                 # shard's carried fold is the same program any owner

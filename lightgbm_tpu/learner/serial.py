@@ -37,7 +37,7 @@ for the distributed learners (the active-leaf histograms gain a ``psum``).
 from __future__ import annotations
 
 import functools
-from typing import NamedTuple, Optional
+from typing import Callable, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
@@ -46,10 +46,11 @@ from ..io.binning import MISSING_NAN, MISSING_NONE, MISSING_ZERO
 from ..io.device import DeviceData
 from ..ops.pallas_histogram import (bin_stride, default_backend,
                                     fused_config_ok, hist_active_pallas,
-                                    hist_active_scatter, hist_route_pallas,
-                                    is_quantized, pack_values,
-                                    pack_values_q, pallas_config_ok,
-                                    transpose_bins)
+                                    hist_active_scatter, hist_raw_layout,
+                                    hist_route_pallas, is_quantized,
+                                    pack_values, pack_values_q,
+                                    pallas_config_ok, transpose_bins,
+                                    unpack_hist_raw)
 from ..ops.pallas_route import (route_rows_pallas, route_rows_values_pallas,
                                 route_rows_xla)
 from ..ops.split import SplitParams, SplitResult, find_best_splits
@@ -429,6 +430,141 @@ def make_hist_fn(data: DeviceData, grad, hess, num_leaf_slots: int,
                 max_bins=data.group_max_bins,
                 num_leaf_slots=num_leaf_slots)
     return hist_fn
+
+
+class HistFold(NamedTuple):
+    """The streamed kernel-fold seam built by :func:`make_hist_fold_fn`.
+
+    ``fold(bins, grad, hess, hist_leaf, active, acc, scales=None)``
+    folds one block's rows into the carried RAW kernel accumulator and
+    returns the new carry; ``init_acc()`` allocates the zero carry;
+    ``unpack(acc, scales=None)`` finalizes the chain to the
+    ``[A, F, B, 3]`` f32 grid the split scan consumes.  ``backend`` is
+    the RESOLVED kernel choice ("pallas"/"compact") after the fold
+    seam's own degradations."""
+    fold: Callable
+    init_acc: Callable
+    unpack: Callable
+    backend: str
+    hist_mode: str
+    quantized: bool
+
+
+def make_hist_fold_fn(data: DeviceData, num_leaf_slots: int,
+                      num_active: int, block_rows: int,
+                      backend: str = "auto",
+                      hist_mode: Optional[str] = None,
+                      num_data: Optional[int] = None
+                      ) -> Optional[HistFold]:
+    """Build the out-of-core histogram FOLD closure — the seeded-kernel
+    twin of :func:`make_hist_fn` for streamed training
+    (``boosting/streaming.py``).
+
+    A streamed tree histograms each wave as a chain of per-block kernel
+    calls that carry the RAW kernel accumulator (``acc`` /
+    ``raw=True`` in the kernels) instead of summing unpacked f32 grids:
+    on the quantized modes (the default) every cell accumulates exactly
+    in int32, and the final :func:`unpack_hist_raw` dequantizes ONCE —
+    bitwise what one monolithic in-memory kernel call produces.  This is
+    what puts streamed training in the byte-identity domain on the
+    kernel backends, not just scatter.
+
+    SANCTIONED REASSOCIATION CONTEXT (tools/numcheck): splitting one
+    kernel reduction into per-block seeded calls reorders nothing — the
+    seeded kernel replays the monolithic kernel's adds in the monolithic
+    order, block boundaries are just program re-entry.  Exactness holds
+    per mode: quantized modes are order-free int32; the wide float modes
+    reuse the identical per-tile add sequence (same row tile for every
+    same-shaped block).  Float COMPACT folds are the one chain-INEXACT
+    case (block-local group padding reorders f32 adds) and are degraded
+    to the wide kernel below.
+
+    Args:
+      num_active: the streamed wave width (streamed trees run every
+        wave at the fixed tail width — ``stage_plan(L)[1]``).
+      block_rows: rows per streamed block (every block padded alike,
+        which keeps the raw layout call-invariant).
+      num_data: GLOBAL stream row count for the quantized-mode row
+        bound (``effective_hist_mode`` must see the stream total, not
+        the block size — a 1B-row stream can overflow an int32 cell
+        even though each block is tiny).  Defaults to ``data.num_data``.
+
+    Returns None when the resolved backend is scatter (caller keeps the
+    carried-f32 scatter fold) or the SEEDED cell is VMEM-infeasible.
+    """
+    from ..ops import compact as compact_mod
+    from ..ops.vmem import hist_fold_cell_ok, round_up
+
+    if hist_mode is None:
+        hist_mode = default_hist_mode()
+    hist_mode = effective_hist_mode(
+        hist_mode, data.num_data if num_data is None else num_data)
+    backend = resolve_backend(data, num_leaf_slots, backend, hist_mode)
+    if not uses_pallas(backend):
+        return None
+    quantized = is_quantized(hist_mode)
+    mb = data.group_max_bins
+    use_compact = wave_uses_compact(backend, num_active)
+    if use_compact and not quantized:
+        use_compact, backend = False, "pallas"
+    if use_compact:
+        extra = compact_mod.COMPACT_GROUP * 4 + 2 * 1024 * 4
+        if not hist_fold_cell_ok(mb, compact_mod.COMPACT_GROUP, hist_mode,
+                                 extra_bytes=extra):
+            use_compact, backend = False, "pallas"
+    if not use_compact and not hist_fold_cell_ok(mb, num_active, hist_mode):
+        return None
+    if not use_compact:
+        backend = "pallas"
+
+    from ..ops.pallas_histogram import DEFAULT_ROW_TILE
+    n_pad = round_up(block_rows, DEFAULT_ROW_TILE)
+    F_pad = data.num_groups     # per-block transpose_bins(feat_tile=None)
+    if use_compact:
+        shape, dtype = compact_mod.compact_raw_layout(
+            n_pad, num_active, F_pad, mb, hist_mode)
+    else:
+        shape, dtype = hist_raw_layout(n_pad, num_active, F_pad, mb,
+                                       hist_mode)
+    interp = _pallas_interpret()
+
+    def init_acc():
+        return jnp.zeros(shape, dtype)
+
+    @jax.jit
+    def fold(bins, grad, hess, hist_leaf, active, acc, scales=None):
+        bins_t = transpose_bins(bins)
+        if quantized:
+            vals, _ = pack_values_q(grad, hess, hist_mode, scales=scales)
+        else:
+            vals = pack_values(grad, hess, hist_mode)
+        leaf = hist_leaf.astype(jnp.int32)
+        if use_compact:
+            return compact_mod.hist_active_compact(
+                bins_t, vals, leaf, active, scales, acc,
+                num_features=F_pad, max_bins=mb,
+                num_leaf_slots=num_leaf_slots, mode=hist_mode,
+                interpret=interp, raw=True)
+        return hist_active_pallas(
+            bins_t, vals, leaf, active, scales, acc,
+            num_features=F_pad, max_bins=mb, mode=hist_mode,
+            interpret=interp, raw=True)
+
+    # the unpack MUST be its own jitted program (not eager): eager
+    # elementwise dequant skips XLA's fma contraction and lands 1 ulp
+    # off the in-memory kernels' fused in-call unpack — enough to break
+    # byte identity.  Jitted, the same elementwise graph compiles to the
+    # same contraction and matches bitwise (pinned by the identity
+    # matrix in tests/test_streaming.py).
+    @jax.jit
+    def unpack(acc, scales=None):
+        if use_compact:
+            return compact_mod.unpack_hist_compact_raw(
+                acc, num_active, data.num_groups, mb, hist_mode, scales)
+        return unpack_hist_raw(acc, num_active, data.num_groups, mb,
+                               hist_mode, scales)
+
+    return HistFold(fold, init_acc, unpack, backend, hist_mode, quantized)
 
 
 def make_route_fn(data: DeviceData, backend: str,

@@ -177,19 +177,35 @@ def compact_plan(hist_leaf: jnp.ndarray, active: jnp.ndarray,
 
 
 def _hist_compact_kernel(tg_ref, ga_ref, bins_ref, vals_ref, leaf_ref,
-                         out_ref, *, n_cols: int, B: int, pad_cols: int):
+                         *refs, n_cols: int, B: int, pad_cols: int,
+                         seeded: bool = False):
     """One (feature-tile, row-tile) cell of the grouped kernel.  Same
     body as the wide ``_hist_kernel`` at the group's column count; the
     accumulator zero-init fires on the first tile of each group run
     (groups are tile-contiguous, so each output block is one
-    consecutive visit)."""
+    consecutive visit).
+
+    ``seeded``: the out-of-core fold variant — the first tile of each
+    group run LOADS the carried accumulator block (aliased to the
+    output, see the wide kernel) instead of zeroing, making a per-block
+    call a bitwise extension of the monolithic one.  The trailing trash
+    group seeds garbage, adds only masked zeros, and is dropped at
+    unpack — deterministic and harmless.
+    """
+    if seeded:
+        acc_ref, out_ref = refs
+    else:
+        (out_ref,) = refs
     i = pl.program_id(1)
     prev = tg_ref[jnp.maximum(i - 1, 0)]
     first = jnp.logical_or(i == 0, tg_ref[i] != prev)
 
     @pl.when(first)
     def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        if seeded:
+            out_ref[:] = acc_ref[:]
+        else:
+            out_ref[:] = jnp.zeros_like(out_ref)
 
     quant = vals_ref.dtype == jnp.int8
     cdt = jnp.int8 if quant else jnp.bfloat16
@@ -207,19 +223,21 @@ def _hist_compact_kernel(tg_ref, ga_ref, bins_ref, vals_ref, leaf_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("num_features", "max_bins", "num_leaf_slots", "mode",
-                     "row_tile", "interpret"))
+                     "row_tile", "interpret", "raw"))
 def hist_active_compact(bins_t: jnp.ndarray,
                         vals: jnp.ndarray,
                         row_leaf: jnp.ndarray,
                         active: jnp.ndarray,
                         scales: jnp.ndarray | None = None,
+                        acc: jnp.ndarray | None = None,
                         *,
                         num_features: int,
                         max_bins: int,
                         num_leaf_slots: int,
                         mode: str = "hilo",
                         row_tile: int = DEFAULT_ROW_TILE,
-                        interpret: bool = False) -> jnp.ndarray:
+                        interpret: bool = False,
+                        raw: bool = False) -> jnp.ndarray:
     """Leaf-compacted histograms for the active leaves: same contract as
     ``hist_active_pallas`` (``-> [A, F, B, 3]`` f32) with per-row MXU
     work independent of ``A``.
@@ -228,6 +246,16 @@ def hist_active_compact(bins_t: jnp.ndarray,
     (padding rows ``-1``).  Unlike the wide kernel, ``-1`` padding
     entries of ``active`` yield exact ZERO slots (their rows never
     enter the compacted stream), matching the scatter oracle.
+
+    ``acc`` / ``raw``: the out-of-core fold operands, mirroring the
+    wide kernel — ``acc`` is the carried RAW accumulator
+    (:func:`compact_raw_layout`, donated via ``input_output_aliases``),
+    ``raw=True`` returns the raw grid for the next block's carry
+    (finalize with :func:`unpack_hist_compact_raw`).  NOTE: on float
+    modes a per-block compact call is NOT chain-exact against the
+    monolithic call (block-local group padding changes f32 add order),
+    so the fold seam (``learner.serial.make_hist_fold_fn``) only routes
+    quantized modes here — int32 accumulation is order-independent.
     """
     F_pad, n_pad = bins_t.shape
     C = vals.shape[0]
@@ -267,34 +295,84 @@ def hist_active_compact(bins_t: jnp.ndarray,
     nft = F_grid // feat_tile
     n_c = bins_c.shape[1]
 
+    seeded = acc is not None
+    in_specs = [
+        pl.BlockSpec((G, 1), lambda j, i, tg: (0, tg[i]),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((feat_tile, T), lambda j, i, tg: (j, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((C, T), lambda j, i, tg: (0, i),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, T), lambda j, i, tg: (0, i),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [group_active, bins_c, vals_c, leaf_c]
+    if seeded:
+        # the carried accumulator walks the OUTPUT's block schedule so
+        # the first-tile-of-group seed-load reads the matching block;
+        # aliased in place (with PrefetchScalarGridSpec the alias index
+        # COUNTS the scalar-prefetch operand: tile_group=0, ga=1,
+        # bins=2, vals=3, leaf=4, acc=5)
+        in_specs.append(pl.BlockSpec((feat_tile * B, cols),
+                                     lambda j, i, tg: (tg[i] * nft + j, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(acc)
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=1,
         grid=(nft, n_c // T),
-        in_specs=[
-            pl.BlockSpec((G, 1), lambda j, i, tg: (0, tg[i]),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((feat_tile, T), lambda j, i, tg: (j, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((C, T), lambda j, i, tg: (0, i),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T), lambda j, i, tg: (0, i),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((feat_tile * B, cols),
                                lambda j, i, tg: (tg[i] * nft + j, 0),
                                memory_space=pltpu.VMEM),
     )
     out = pl.pallas_call(
         functools.partial(_hist_compact_kernel, n_cols=C, B=B,
-                          pad_cols=pad_cols),
+                          pad_cols=pad_cols, seeded=seeded),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct(
             ((n_groups + 1) * F_grid * B, cols),
             jnp.int32 if is_quantized(mode) else jnp.float32),
+        input_output_aliases=({5: 0} if seeded else {}),
         interpret=interpret,
-    )(tile_group, group_active, bins_c, vals_c, leaf_c)
+    )(tile_group, *operands)
 
-    # [(n_groups+1)*F_grid*B, cols] -> [A, F, B, 3] (trash block dropped)
+    if raw:
+        return out
+    return unpack_hist_compact_raw(out, A, num_features, max_bins, mode,
+                                   scales)
+
+
+def compact_raw_layout(n_pad: int, num_active: int, num_features: int,
+                       max_bins: int, mode: str,
+                       row_tile: int = DEFAULT_ROW_TILE):
+    """``-> (((n_groups+1)*F_grid*B, cols), dtype)`` of the RAW grouped
+    accumulator — the streamed-fold carry for ``hist_active_compact``
+    (twin of ``pallas_histogram.hist_raw_layout``; same tile arithmetic
+    as the kernel, so it is call-invariant across same-shaped blocks)."""
+    B = bin_stride(max_bins)
+    G = COMPACT_GROUP
+    n_groups = -(-num_active // G)
+    C, Gp, cols = _col_layout(G, mode)
+    T = _pick_row_tile(n_pad, B, cols, C, row_tile)
+    ft_cap = max(1, _feat_tile_cap(B, cols, T, C))
+    F_pad = num_features
+    feat_tile = F_pad if ft_cap >= F_pad else max(8, (ft_cap // 8) * 8)
+    F_grid = _round_up(F_pad, feat_tile)
+    dtype = jnp.int32 if is_quantized(mode) else jnp.float32
+    return ((n_groups + 1) * F_grid * B, cols), dtype
+
+
+def unpack_hist_compact_raw(out: jnp.ndarray, num_active: int,
+                            num_features: int, max_bins: int, mode: str,
+                            scales: jnp.ndarray | None = None):
+    """RAW grouped accumulator -> ``[A, F, B, 3]`` f32 (trash block
+    dropped).  One-shot finalization of a streamed compact fold chain."""
+    A = num_active
+    B = bin_stride(max_bins)
+    G = COMPACT_GROUP
+    n_groups = -(-A // G)
+    C, Gp, cols = _col_layout(G, mode)
+    F_grid = out.shape[0] // ((n_groups + 1) * B)
     out = out.reshape(n_groups + 1, F_grid, B, cols)[
         :n_groups, :, :, :C * Gp]
     out = out.reshape(n_groups, F_grid, B, C, Gp)

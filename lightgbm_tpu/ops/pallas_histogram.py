@@ -204,7 +204,8 @@ def pack_values(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
 
 def pack_values_q(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
                   row_tile: int = DEFAULT_ROW_TILE,
-                  key: jnp.ndarray | None = None):
+                  key: jnp.ndarray | None = None,
+                  scales: jnp.ndarray | None = None):
     """Quantized value rows for the int8 MXU path: ``-> (vals int8
     [C, n_pad], scales f32 [2])``.
 
@@ -226,14 +227,24 @@ def pack_values_q(grad: jnp.ndarray, hess: jnp.ndarray, mode: str,
     ``key``: optional PRNG key for stochastic rounding (unbiased sums:
     E[q] == x, so quantization noise averages out over a leaf instead
     of accumulating a rounding bias).
+
+    ``scales``: optional precomputed ``[2] f32 (sg, sh)`` — the streamed
+    fold path (``boosting/streaming.py``) quantizes each BLOCK of a tree
+    with the tree's GLOBAL absmax scales (host-computed over every
+    block), so per-row int8 codes — and therefore the exact int32 bin
+    sums — are bitwise what the monolithic in-memory pack produces.
+    When omitted, scales are derived from this call's rows as before.
     """
     n = grad.shape[0]
     n_pad = _round_up(n, row_tile)
     pad = (0, n_pad - n)
     g = grad.astype(jnp.float32)
     h = hess.astype(jnp.float32)
-    sg = jnp.maximum(jnp.max(jnp.abs(g)), 1e-30)
-    sh = jnp.maximum(jnp.max(jnp.abs(h)), 1e-30)
+    if scales is None:
+        sg = jnp.maximum(jnp.max(jnp.abs(g)), 1e-30)
+        sh = jnp.maximum(jnp.max(jnp.abs(h)), 1e-30)
+    else:
+        sg, sh = scales[0], scales[1]
 
     def q(x, scale, sub):
         t = x * (127.0 / scale)
@@ -330,19 +341,36 @@ def _weighted_cols(m_bool: jnp.ndarray, vals: jnp.ndarray, n_cols: int,
 
 
 def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref,
-                 out_ref, *, n_cols: int, B: int, pad_cols: int):
+                 *refs, n_cols: int, B: int, pad_cols: int,
+                 seeded: bool = False):
     """One (feature-tile, row-tile) grid cell; accumulates over row tiles.
 
     Everything rides rows-on-lanes: the leaf mask is built ``[A_pad, T]``
     (no per-tile transpose of the leaf row) and the weighted values as
     ``vw [cols, T]``, contracted against the one-hot on the lane
     dimension of BOTH operands.
+
+    ``seeded``: the out-of-core fold variant.  Instead of zero-initing
+    the accumulator on the first row tile of each feature block, the
+    kernel LOADS a carried accumulator operand (``acc_ref``, aliased to
+    the output buffer via ``input_output_aliases`` so the seed is a
+    donated in-place init, not a copy).  A per-block call is then a
+    bitwise EXTENSION of the monolithic kernel: same adds in the same
+    order, just split across calls — which is what puts streamed
+    training in the byte-identity domain on the kernel backends.
     """
+    if seeded:
+        acc_ref, out_ref = refs
+    else:
+        (out_ref,) = refs
     rt = pl.program_id(1)
 
     @pl.when(rt == 0)
     def _():
-        out_ref[:] = jnp.zeros_like(out_ref)
+        if seeded:
+            out_ref[:] = acc_ref[:]
+        else:
+            out_ref[:] = jnp.zeros_like(out_ref)
 
     quant = vals_ref.dtype == jnp.int8
     cdt = jnp.int8 if quant else jnp.bfloat16
@@ -362,18 +390,20 @@ def _hist_kernel(active_ref, bins_ref, vals_ref, leaf_ref,
 @functools.partial(
     jax.jit,
     static_argnames=("num_features", "max_bins", "mode", "row_tile",
-                     "interpret"))
+                     "interpret", "raw"))
 def hist_active_pallas(bins_t: jnp.ndarray,
                        vals: jnp.ndarray,
                        row_leaf: jnp.ndarray,
                        active: jnp.ndarray,
                        scales: jnp.ndarray | None = None,
+                       acc: jnp.ndarray | None = None,
                        *,
                        num_features: int,
                        max_bins: int,
                        mode: str = "hilo",
                        row_tile: int = DEFAULT_ROW_TILE,
-                       interpret: bool = False) -> jnp.ndarray:
+                       interpret: bool = False,
+                       raw: bool = False) -> jnp.ndarray:
     """Histograms for the active leaves: ``-> [A, F, B, 3]`` float32.
 
     Args:
@@ -385,12 +415,23 @@ def hist_active_pallas(bins_t: jnp.ndarray,
       active: ``[A]`` int32 leaf ids to histogram; ``-1`` entries are
         padding (their output slots contain garbage from bagged-out rows
         and must be dropped by the caller).
+      acc: optional carried RAW accumulator ``[F_grid*B, cols]``
+        (:func:`hist_raw_layout`; donated — the kernel seeds its output
+        buffer from it in place via ``input_output_aliases`` instead of
+        zero-initing).  The out-of-core fold operand: this call's rows
+        extend the accumulation bitwise, exactly as if they had been
+        part of one monolithic call.
       num_features: true F (<= F_pad).
       max_bins: true per-feature bin-count bound; output B = its stride.
+      raw: return the RAW ``[F_grid*B, cols]`` kernel accumulator
+        (int32 on the quantized path) instead of unpacking — the carry
+        for the next block's ``acc``.  Unpack once at the end of the
+        fold chain with :func:`unpack_hist_raw`.
 
     Returns:
       ``[A, F, B, 3]`` f32 with B = ``bin_stride(max_bins)``, cells
-      ``(sum_grad, sum_hess, count)``.
+      ``(sum_grad, sum_hess, count)`` — or the raw accumulator when
+      ``raw=True``.
 
     MXU cost scales with ``round128(C*round8(A))`` — small waves are
     proportionally cheap.
@@ -428,31 +469,86 @@ def hist_active_pallas(bins_t: jnp.ndarray,
     # active padding so neither lands in a real column block; -1 actives
     # (wave padding) DO accumulate bagged-out rows, caller drops them.
     grid = (F_grid // feat_tile, n_pad // T)
+    seeded = acc is not None
+    in_specs = [
+        pl.BlockSpec((A_pad, 1), lambda f, r: (0, 0),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((feat_tile, T), lambda f, r: (f, r),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((C, T), lambda f, r: (0, r),
+                     memory_space=pltpu.VMEM),
+        pl.BlockSpec((1, T), lambda f, r: (0, r),
+                     memory_space=pltpu.VMEM),
+    ]
+    operands = [act, bins_t, vals, leaf]
+    if seeded:
+        # the carried accumulator mirrors the OUTPUT's block walk
+        # ((f, 0): per-feature-tile, revisited across row tiles) so the
+        # rt==0 seed-load reads the matching seed block; aliasing it to
+        # the output (input index 4 -> output 0) makes the seed a
+        # donated in-place init — no extra HBM buffer, no copy
+        in_specs.append(pl.BlockSpec((feat_tile * B, cols),
+                                     lambda f, r: (f, 0),
+                                     memory_space=pltpu.VMEM))
+        operands.append(acc)
     out = pl.pallas_call(
-        functools.partial(_hist_kernel, n_cols=C, B=B, pad_cols=pad_cols),
+        functools.partial(_hist_kernel, n_cols=C, B=B, pad_cols=pad_cols,
+                          seeded=seeded),
         grid=grid,
-        in_specs=[
-            pl.BlockSpec((A_pad, 1), lambda f, r: (0, 0),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((feat_tile, T), lambda f, r: (f, r),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((C, T), lambda f, r: (0, r),
-                         memory_space=pltpu.VMEM),
-            pl.BlockSpec((1, T), lambda f, r: (0, r),
-                         memory_space=pltpu.VMEM),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((feat_tile * B, cols),
                                lambda f, r: (f, 0),
                                memory_space=pltpu.VMEM),
         out_shape=jax.ShapeDtypeStruct(
             (F_grid * B, cols),
             jnp.int32 if is_quantized(mode) else jnp.float32),
+        input_output_aliases=({4: 0} if seeded else {}),
         interpret=interpret,
-    )(act, bins_t, vals, leaf)
+    )(*operands)
 
+    if raw:
+        return out
     # [F_grid*B, cols] -> [A, F, B, C'] -> combine hi/lo -> [A, F, B, 3]
     return _unpack_hist(out, B, cols, C, A_pad, A, num_features, mode,
                         scales)
+
+
+def hist_raw_layout(n_pad: int, num_active: int, num_features: int,
+                    max_bins: int, mode: str,
+                    row_tile: int = DEFAULT_ROW_TILE):
+    """``-> ((F_grid*B, cols), dtype)`` of the RAW wide-kernel
+    accumulator for this config — the shape a streamed fold carries
+    across blocks (``acc`` / ``raw=True`` in :func:`hist_active_pallas`).
+
+    Replicates the kernel's own tile arithmetic (row tile from the VMEM
+    model, feature tile from :func:`feat_tile_cap`), so the carry can be
+    allocated before the first call.  ``num_features`` must equal the
+    bins' F_pad (streamed sources transpose with ``feat_tile=None``, so
+    F_pad == F); ``n_pad`` is the per-block padded row count — every
+    block of a stream uses the same one, which is what keeps the layout
+    call-invariant.
+    """
+    B = bin_stride(max_bins)
+    C, A_pad, cols = _col_layout(num_active, mode)
+    T = _pick_row_tile(n_pad, B, cols, C, row_tile)
+    ft_cap = max(1, _feat_tile_cap(B, cols, T, C))
+    F_pad = num_features
+    feat_tile = F_pad if ft_cap >= F_pad else max(8, (ft_cap // 8) * 8)
+    F_grid = _round_up(F_pad, feat_tile)
+    dtype = jnp.int32 if is_quantized(mode) else jnp.float32
+    return (F_grid * B, cols), dtype
+
+
+def unpack_hist_raw(out: jnp.ndarray, num_active: int, num_features: int,
+                    max_bins: int, mode: str,
+                    scales: jnp.ndarray | None = None) -> jnp.ndarray:
+    """RAW wide-kernel accumulator -> ``[A, F, B, 3]`` f32.  The one-shot
+    finalization of a streamed fold chain (dequantize / combine hi-lo
+    exactly once, after all blocks have accumulated exactly)."""
+    B = bin_stride(max_bins)
+    C, A_pad, cols = _col_layout(num_active, mode)
+    return _unpack_hist(out, B, cols, C, A_pad, num_active, num_features,
+                        mode, scales)
 
 
 def _unpack_hist(out, B, cols, C, A_pad, A, num_features, mode, scales):

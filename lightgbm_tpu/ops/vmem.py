@@ -46,6 +46,7 @@ VMEM_GUARDS = (
     "fused_config_ok",       # fused route+hist kernel
     "compact_config_ok",     # leaf-compacted deep-wave kernel
     "hist_cell_ok",          # the generic predicate below
+    "hist_fold_cell_ok",     # accumulator-seeded streamed-fold variant
     "split_lane_chunk_features",   # fused split kernel's lane chunking
     "split_scan_chunk_features",   # XLA split scan's HBM chunking
 )
@@ -116,6 +117,23 @@ def hist_cell_ok(max_bins: int, active_slots: int, mode: str,
     C, _, cols = col_layout(active_slots, mode)
     return (cell_vmem_bytes(8, B, cols, row_tile, C) + extra_bytes
             <= VMEM_BUDGET_BYTES)
+
+
+def hist_fold_cell_ok(max_bins: int, active_slots: int, mode: str,
+                      row_tile: int = 1024, extra_bytes: int = 0) -> bool:
+    """Feasibility of the accumulator-SEEDED histogram cell (the
+    out-of-core fold variant of the kernels): on top of
+    :func:`hist_cell_ok`'s residents, the carried accumulator operand
+    adds one more ``[ft*B, cols]`` block (same element size as the
+    output; int32 on the quantized modes) fetched into VMEM for the
+    seed-load.  ``extra_bytes`` composes with kernel-specific residents
+    exactly as in :func:`hist_cell_ok` (the compacted fold passes its
+    group-active slice + leaf row through here)."""
+    B = bin_stride(max_bins)
+    C, _, cols = col_layout(active_slots, mode)
+    seed = 8 * B * cols * 4              # acc block at the min feat tile
+    return hist_cell_ok(max_bins, active_slots, mode, row_tile,
+                        extra_bytes + seed)
 
 
 def split_vmem_budget_bytes() -> int:

@@ -87,7 +87,13 @@ the seams where production faults actually strike:
   without a failure, under the sub-deadline threshold where
   ``collective.hang`` would trip rank loss; the fleet-observability
   tests use it to prove ``tools/fleet_report.py`` names the exact slow
-  rank and site from wait/xfer accounting alone.
+  rank and site from wait/xfer accounting alone,
+* ``stream.upload`` — the streamed trainer's per-block device upload
+  (``boosting/streaming.py _upload_block``, the staging half of the
+  upload/compute pipeline): retried by the shared policy BEFORE the
+  block's fold is dispatched, so tests prove a transient device fault
+  mid-pipeline is retried without a torn (double-counted or skipped)
+  histogram fold.
 
 Each point is a single ``fault_point(name)`` call that is a no-op unless
 armed.  Tests arm points programmatically (:func:`inject`, or the
@@ -122,7 +128,12 @@ POINTS = ("snapshot.write", "collective.allgather", "rendezvous.connect",
           # swaps the canonical chunk+pairwise root reducer back to a
           # raw jnp.sum (learner/serial.py root_stats) — the PR 14
           # reassociation bug class
-          "num.reassoc")
+          "num.reassoc",
+          # the streamed pipeline's per-block device_put
+          # (boosting/streaming.py _upload_block): a transient device
+          # fault mid-pipeline must retry BEFORE the fold dispatch, so
+          # a retried upload can never tear a fold
+          "stream.upload")
 
 
 class FaultInjected(RuntimeError):

@@ -244,6 +244,12 @@ def test_dryrun_emits_wave_table_and_north_star_parses():
     assert out["stream_host_rss_peak_bytes"] > 0
     assert isinstance(out["stream_model_digest"], str) \
         and len(out["stream_model_digest"]) == 64
+    # ISSUE 20: the A/B columns — resolved backend, ledger rows/s, and
+    # the two speedup verdicts (sanity on CPU, throughput on TPU)
+    assert out["stream_backend"] in ("scatter", "pallas", "compact")
+    assert out["stream_rows_per_sec"] > 0
+    assert out["stream_kernel_speedup"] > 0
+    assert out["stream_pipeline_speedup"] > 0
     assert out["north_star_aux_detail"]["stream_ingest"] in (
         "measured", "pending-capture"), out["north_star_aux_detail"]
     # elastic chaos gate (ISSUE 16): the REAL SIGKILL shrink+regrow

@@ -9,8 +9,24 @@ host→device streaming, host-resident scores — must be BYTE-IDENTICAL
 on the exact-accumulation scatter backend (the CPU default).  Plus:
 source independence (mmap cache vs resident RAM), block-size
 invariance, tail blocks, and the documented descopes.
+
+ISSUE 20 extends the matrix to the kernel backends and the pipeline:
+
+* accumulator-SEEDED Pallas/compact folds (``make_hist_fold_fn``) are
+  byte-identical to the in-memory monolithic kernels, serial AND
+  2-shard (kernels force-run on CPU through the auto-interpret path);
+* the depth-2 upload/compute pipeline (``LGBM_TPU_STREAM_PIPELINE``)
+  and its serial escape hatch produce the identical model, with the
+  overlap PROVEN from telemetry;
+* a transient ``stream.upload`` fault retries without tearing a fold,
+  and a real SIGKILL landing mid-pipeline leaves the shard cache
+  restartable to the clean digest.
 """
 import os
+import signal
+import subprocess
+import sys
+import textwrap
 
 import numpy as np
 import jax
@@ -207,3 +223,180 @@ def test_train_streaming_public_surface(tmp_path):
     assert bst.num_trees() == 3
     assert os.path.exists(os.path.join(str(tmp_path / "cache"),
                                        oc.MANIFEST))
+
+
+# ---------------------------------------------------------------------------
+# ISSUE 20: accumulator-seeded kernel folds + the upload/compute pipeline
+# ---------------------------------------------------------------------------
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+# (backend, extra env): compact's slot threshold drops to 4 so the
+# num_leaves=15 tail wave actually selects the compact kernel on the
+# toy tree
+KERNEL_BACKENDS = [
+    ("pallas", {}),
+    ("compact", {"LGBM_TPU_COMPACT_SLOTS": "4"}),
+]
+
+
+@pytest.mark.parametrize("backend,extra", KERNEL_BACKENDS,
+                         ids=[b for b, _ in KERNEL_BACKENDS])
+def test_streamed_kernel_fold_byte_identical(monkeypatch, backend, extra):
+    """ISSUE 20 gate: the accumulator-SEEDED kernel folds (carried
+    operand via input_output_aliases) make multi-block streamed
+    training byte-identical to the in-memory monolithic kernel — both
+    sides forced onto the same backend, run on CPU through the
+    auto-interpret path."""
+    monkeypatch.setenv("LGBM_TPU_HIST_BACKEND", backend)
+    for k, v in extra.items():
+        monkeypatch.setenv(k, v)
+    X, y = _data()
+    params = dict(BASE, num_iterations=3)
+    cfg, res = _resident(X, y, params)
+    tr = StreamTrainer(cfg, res, block_rows=STREAM_CHUNK)
+    assert tr._fold is not None, "seeded fold must engage"
+    assert tr.backend == backend
+    assert len(tr._blocks()) > 1, "parity must exercise MULTI-block"
+    assert tr.train(3).digest() == _mem_digest(X, y, params)
+
+
+@pytest.mark.parametrize("backend,extra", KERNEL_BACKENDS,
+                         ids=[b for b, _ in KERNEL_BACKENDS])
+def test_two_shard_kernel_fold_parity(backend, extra):
+    """Seeded kernel folds under 2-shard data-parallel == the
+    in-memory 2-shard mesh.  Re-execed in a child with a forced
+    2-device CPU pool (tier-1 runs on one device; XLA_FLAGS must be
+    fixed before jax initializes), odd row count for the pad path."""
+    child = textwrap.dedent(f"""
+        import os
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        os.environ["XLA_FLAGS"] = (os.environ.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2").strip()
+        os.environ["LGBM_TPU_HIST_BACKEND"] = {backend!r}
+        os.environ.update({extra!r})
+        import sys
+        sys.path.insert(0, {_REPO!r})
+        import numpy as np
+        import lightgbm_tpu as lgb
+        from lightgbm_tpu.boosting.streaming import StreamTrainer
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.io.dataset import BinnedDataset, Metadata
+        from lightgbm_tpu.learner.serial import STREAM_CHUNK
+        rng = np.random.RandomState(9)
+        n = 2 * STREAM_CHUNK + 4001
+        X = rng.normal(size=(n, 6))
+        y = (X[:, 0] + 0.5 * X[:, 1]
+             + rng.normal(scale=0.3, size=n) > 0).astype(np.float32)
+        params = {{"objective": "binary", "num_leaves": 15,
+                   "max_bin": 63, "learning_rate": 0.1,
+                   "num_iterations": 3, "verbose": -1,
+                   "tree_learner": "data", "mesh_shape": [2]}}
+        cfg = Config.from_params(params)
+        md = Metadata()
+        md.set_field("label", y)
+        res = BinnedDataset.from_raw(X, cfg, metadata=md)
+        tr = StreamTrainer(cfg, res, block_rows=STREAM_CHUNK)
+        assert tr.S == 2 and tr._fold is not None
+        assert tr.backend == {backend!r}, tr.backend
+        d_str = tr.train(3).digest()
+        d_mem = lgb.train(params, lgb.Dataset(X, label=y,
+                                              params=params))._gbdt.digest()
+        assert d_str == d_mem, (d_str, d_mem)
+        print("PARITY-OK", d_str)
+    """)
+    proc = subprocess.run([sys.executable, "-c", child], cwd=_REPO,
+                          capture_output=True, text=True, timeout=600)
+    assert proc.returncode == 0, proc.stderr[-4000:]
+    assert "PARITY-OK" in proc.stdout
+
+
+def test_pipeline_toggle_byte_identical_and_overlaps(monkeypatch):
+    """LGBM_TPU_STREAM_PIPELINE (detcheck DET005
+    ``stream-pipeline-vs-serial``): the depth-2 pipeline and the
+    serial escape hatch produce the identical model — the fold order
+    never changes — and the pipelined run PROVES overlap through the
+    ``stream.pipeline.overlap_s`` counter and the staging spans."""
+    from lightgbm_tpu.obs import telemetry
+    X, y = _data()
+    monkeypatch.setenv("LGBM_TPU_STREAM_PIPELINE", "0")
+    cfg, res = _resident(X, y, BASE)
+    tr = StreamTrainer(cfg, res, block_rows=STREAM_CHUNK)
+    assert not tr._pipeline_on
+    d_serial = tr.train(5).digest()
+    monkeypatch.setenv("LGBM_TPU_STREAM_PIPELINE", "1")
+    cfg2, res2 = _resident(X, y, BASE)
+    telemetry.reset()
+    telemetry.enable()
+    try:
+        tr2 = StreamTrainer(cfg2, res2, block_rows=STREAM_CHUNK)
+        assert tr2._pipeline_on
+        d_pipe = tr2.train(5).digest()
+        summ = telemetry.summary()
+    finally:
+        telemetry.reset()
+    assert d_pipe == d_serial == _mem_digest(X, y, BASE)
+    assert summ["counters"].get("stream.pipeline.overlap_s", 0) > 0
+    for span in ("stream.prefetch", "stream.upload", "stream.fold"):
+        assert summ["spans"][span]["count"] > 0, span
+
+
+def test_stream_upload_fault_retried_without_torn_fold(monkeypatch):
+    """A transient ``stream.upload`` fault fires BEFORE the block's
+    fold is dispatched (the fault point sits inside the retried
+    ``put``), so the retry re-uploads the same staged block and no
+    fold is torn: the final model equals the clean run's."""
+    from lightgbm_tpu.utils import faults, retry
+    X, y = _data()
+    clean = _mem_digest(X, y, BASE)
+    monkeypatch.setattr(retry, "_sleep", lambda s: None)
+    cfg, res = _resident(X, y, BASE)
+    with faults.injected("stream.upload", times=2):
+        d = StreamTrainer(cfg, res,
+                          block_rows=STREAM_CHUNK).train(5).digest()
+        assert faults.fired("stream.upload") == 2
+    assert d == clean
+
+
+def test_sigkill_mid_pipeline_restart_byte_identical(tmp_path):
+    """A real SIGKILL landing mid-pipeline (stager thread armed, an
+    upload in flight while the previous block's fold is dispatched)
+    cannot tear the on-disk shard cache: a fresh run over the SAME
+    store reproduces the clean in-memory digest."""
+    X, y = _data(seed=21)
+    rows = np.concatenate([y[:, None], X], axis=1)
+    p = os.path.join(str(tmp_path), "all.csv")
+    np.savetxt(p, rows, delimiter=",", fmt="%.9g")
+    cache = str(tmp_path / "cache")
+    child = textwrap.dedent(f"""
+        import os, signal, sys
+        sys.path.insert(0, {_REPO!r})
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from lightgbm_tpu.config import Config
+        from lightgbm_tpu.io import outofcore as oc
+        from lightgbm_tpu.boosting import streaming
+        cfg = Config.from_params({BASE!r})
+        store = oc.ingest([{p!r}], cfg, {cache!r})
+        orig = streaming.StreamTrainer._upload_block
+        calls = [0]
+        def killer(self, staged):
+            calls[0] += 1
+            if calls[0] == 4:
+                # 2nd iteration, 2nd block: the stager just staged it
+                # and block 0's fold is dispatched but not awaited
+                os.kill(os.getpid(), signal.SIGKILL)
+            return orig(self, staged)
+        streaming.StreamTrainer._upload_block = killer
+        streaming.StreamTrainer(cfg, store, block_rows=8192).train(5)
+    """)
+    proc = subprocess.run([sys.executable, "-c", child], cwd=_REPO,
+                          capture_output=True, timeout=600)
+    assert proc.returncode == -signal.SIGKILL
+    # the cache survived the kill: the manifest is intact and the
+    # restart reuses it (no re-ingest), training to the clean digest
+    cfg = Config.from_params(BASE)
+    store = oc.ingest([p], cfg, cache)
+    assert store.n == N
+    d = StreamTrainer(cfg, store, block_rows=STREAM_CHUNK).train(5).digest()
+    from lightgbm_tpu.io.loader import parse_file
+    Xp, yp, _, _, _, _ = parse_file(p, cfg)
+    assert d == _mem_digest(Xp, yp, BASE)

@@ -75,7 +75,11 @@ PROGRAM_PAIRS: Tuple[Dict, ...] = (
     {"name": "hist-backend-selection",
      "env": "LGBM_TPU_HIST_BACKEND",
      "programs": ("scatter histogram", "wide fused Pallas kernel",
-                  "leaf-compacted Pallas kernel"),
+                  "leaf-compacted Pallas kernel",
+                  "their accumulator-seeded streamed-fold twins "
+                  "(learner/serial.py make_hist_fold_fn; streamed=="
+                  "resident per backend pinned by "
+                  "tests/test_streaming.py)"),
      "test": "tests/test_compact.py"},
     {"name": "compact-vs-wide-kernel",
      "env": "LGBM_TPU_NO_COMPACT",
@@ -129,8 +133,17 @@ PROGRAM_PAIRS: Tuple[Dict, ...] = (
      "env": "LGBM_TPU_STREAM_ROWS",
      "programs": ("streamed block trainer (boosting/streaming.py: "
                   "out-of-core mmap blocks, carried-accumulator "
-                  "histogram folds, host-resident scores)",
+                  "histogram folds — row-order scatter AND the "
+                  "accumulator-seeded Pallas/compact kernel folds — "
+                  "host-resident scores)",
                   "resident in-memory fused training loop"),
+     "test": "tests/test_streaming.py"},
+    {"name": "stream-pipeline-vs-serial",
+     "env": "LGBM_TPU_STREAM_PIPELINE",
+     "programs": ("depth-2 prefetch+staging upload/compute pipeline "
+                  "(block k+1 staged and device_put before block k's "
+                  "fold await; fold order unchanged)",
+                  "serial stage->upload->fold->await escape hatch"),
      "test": "tests/test_streaming.py"},
     {"name": "elastic-vs-single-process",
      "env": "LGBM_TPU_ELASTIC",

@@ -13,7 +13,14 @@ asserted within each shard-count group:
 * ``S=2`` — ``mesh2`` (in-memory 2-shard data-parallel mesh),
   ``mesh2_block0`` (the same mesh under the ``LGBM_TPU_MESH_BLOCK=0``
   per-iteration escape hatch), ``stream2`` (streamed 2-shard), and
-  ``elastic1`` (the elastic protocol at world 1 pinned to ``S=2``).
+  ``elastic1`` (the elastic protocol at world 1 pinned to ``S=2``);
+* ``S=1·pallas`` / ``S=1·compact`` — the ISSUE 20 streamed-kernel
+  groups: ``serial_<backend>`` (in-memory monolithic kernel) vs
+  ``stream1_<backend>`` (accumulator-seeded per-block kernel folds),
+  both force-run on CPU through the auto-interpret path.  These are
+  SEPARATE groups: the quantized kernel histograms legitimately
+  differ in value from the exact scatter backend, so the law is
+  identity within a forced backend, never across backends.
 
 (Serial and 2-shard models legitimately differ: per-shard partials
 combine through the psum seam in a different — but partition-pinned —
@@ -73,6 +80,10 @@ MATRIX: Dict[str, str] = {
     "mesh2_block0": "S=2",
     "stream2": "S=2",
     "elastic1": "S=2",
+    "serial_pallas": "S=1·pallas",
+    "stream1_pallas": "S=1·pallas",
+    "serial_compact": "S=1·compact",
+    "stream1_compact": "S=1·compact",
 }
 
 BASE_PARAMS = {"objective": "binary", "num_leaves": 7,
@@ -111,10 +122,39 @@ def run_once(scenario: str, rows: int, rounds: int) -> Dict:
     num_contract.reset()
     X, y = _toy_data(rows)
     params = {**BASE_PARAMS, "num_iterations": rounds}
-    if scenario in ("mesh2", "mesh2_block0"):
+    # ISSUE 20 streamed-kernel scenarios: "<base>_<backend>" forces the
+    # histogram backend on BOTH sides of the pair (env save/restored);
+    # compact additionally drops its slot threshold and deepens the
+    # tree so the tail wave actually selects the compact kernel
+    base, fenv = scenario, {}
+    for suf in ("_pallas", "_compact"):
+        if scenario.endswith(suf):
+            base, bk = scenario[:-len(suf)], suf[1:]
+            fenv = {"LGBM_TPU_HIST_BACKEND": bk}
+            if bk == "compact":
+                fenv["LGBM_TPU_COMPACT_SLOTS"] = "4"
+                params["num_leaves"] = 15
+    saved = {k: os.environ.get(k) for k in fenv}
+    os.environ.update(fenv)
+    try:
+        return _run_base(base, scenario, X, y, params, fenv)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _run_base(base: str, scenario: str, X, y, params, fenv) -> Dict:
+    import lightgbm_tpu as lgb
+    from lightgbm_tpu.boosting.streaming import (StreamTrainer,
+                                                 train_elastic)
+    from lightgbm_tpu.obs import determinism, num_contract
+    if base in ("mesh2", "mesh2_block0"):
         params.update({"tree_learner": "data", "mesh_shape": [2]})
-    if scenario in ("serial", "mesh2", "mesh2_block0"):
-        block0 = scenario == "mesh2_block0"
+    if base in ("serial", "mesh2", "mesh2_block0"):
+        block0 = base == "mesh2_block0"
         old = os.environ.get("LGBM_TPU_MESH_BLOCK")
         if block0:
             os.environ["LGBM_TPU_MESH_BLOCK"] = "0"
@@ -127,11 +167,15 @@ def run_once(scenario: str, rows: int, rounds: int) -> Dict:
                     os.environ.pop("LGBM_TPU_MESH_BLOCK", None)
                 else:
                     os.environ["LGBM_TPU_MESH_BLOCK"] = old
-    elif scenario in ("stream1", "stream2"):
+    elif base in ("stream1", "stream2"):
         cfg, res = _resident(X, y, params)
-        shards = 2 if scenario == "stream2" else 0
-        gbdt = StreamTrainer(cfg, res, num_shards=shards).train()
-    elif scenario == "elastic1":
+        shards = 2 if base == "stream2" else 0
+        tr = StreamTrainer(cfg, res, num_shards=shards)
+        if fenv:
+            assert tr.backend == fenv["LGBM_TPU_HIST_BACKEND"], \
+                f"{scenario}: forced backend not engaged ({tr.backend})"
+        gbdt = tr.train()
+    elif base == "elastic1":
         from lightgbm_tpu.parallel.elastic import (ElasticClient,
                                                    ElasticCoordinator)
         cfg, res = _resident(X, y, params)

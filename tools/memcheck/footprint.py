@@ -114,6 +114,7 @@ class Target:
     trees: int = 0               # serve
     bucket_rows: int = 0         # serve
     stream_rows: int = 0         # stream: LGBM_TPU_STREAM_ROWS block
+    pipeline: bool = True        # stream: prefetch pipeline armed
     slack: float = 1.25
 
 
@@ -152,6 +153,7 @@ def load_targets(path: str) -> Tuple[List[Target], Optional[str]]:
                 trees=int(t.get("trees", 0)),
                 bucket_rows=int(t.get("bucket_rows", 0)),
                 stream_rows=int(t.get("stream_rows", 0)),
+                pipeline=bool(t.get("pipeline", True)),
                 slack=float(t.get("slack", 1.25))))
     except (KeyError, TypeError, ValueError) as exc:
         return [], f"bad target spec: {type(exc).__name__}: {exc}"
@@ -228,18 +230,34 @@ def stream_footprint(t: Target) -> Footprint:
     documentation (the dataset scale the target represents); it never
     enters the device arithmetic, and the bench leg's runtime
     watermark (``stream_peak_hbm_bytes``) is the empirical half of the
-    same claim."""
+    same claim.
+
+    ISSUE 20: when the upload/compute ``pipeline`` is armed (the
+    runtime default, ``LGBM_TPU_STREAM_PIPELINE``), block k+1's staged
+    uploads land on device BEFORE block k's fold is awaited, so the
+    steady state holds THREE block generations of bins/grad/hess (the
+    computing block, the XLA double buffer, the staged next block)
+    instead of two; and the kernel folds carry a RAW seeded
+    accumulator (int32/f32 at the kernel's padded column layout) whose
+    donated chain keeps one extra generation live at dispatch."""
     R, F, K = t.stream_rows, t.features, max(1, t.classes)
     B = bin_stride(t.max_bin)
     fp = Footprint()
-    # one block resident + one in flight (double buffer)
-    fp.parts["block_bins"] = 2 * R * F
-    fp.parts["block_grad_hess"] = 2 * 2 * R * 4
-    fp.parts["block_leaf2"] = 2 * 2 * R * 4
-    fp.parts["block_scores"] = 2 * R * K * 4
+    # blocks in flight: one computing + one XLA double buffer, +1 for
+    # the pipeline's staged next block when armed
+    depth = 3 if t.pipeline else 2
+    fp.parts["block_bins"] = depth * R * F
+    fp.parts["block_grad_hess"] = depth * 2 * R * 4
+    fp.parts["block_leaf2"] = 2 * 2 * R * 4       # wave carry stays serial
+    fp.parts["block_scores"] = 2 * R * K * 4      # score loop stays serial
     # resident per-leaf state: the wave accumulator (per shard), the
     # sibling-subtract histogram state, split-scan intermediates
     fp.parts["wave_acc"] = WAVE_SLOT_CAP * F * B * 3 * 4
+    # the seeded kernel folds' raw carry (ISSUE 20): [F*B, cols] at the
+    # wide kernel's padded column layout, two generations (donor +
+    # result) live across a fold dispatch
+    raw_cols = _round_up(5 * WAVE_SLOT_CAP, LANE)
+    fp.parts["raw_fold_acc"] = 2 * F * B * raw_cols * 4
     fp.parts["hist_state"] = t.leaves * F * B * 3 * 4
     scan_slots = max(min(2 * WAVE_SLOT_CAP, 2 * t.leaves), t.leaves)
     fp.parts["split_scan"] = _split_scan_part(scan_slots, F, B)

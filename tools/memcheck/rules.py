@@ -64,7 +64,7 @@ RULE_TITLES = {
 # tests/test_memcheck.py::test_guard_registry_matches_ops_vmem
 DEFAULT_VMEM_GUARDS = (
     "pallas_config_ok", "fused_config_ok", "compact_config_ok",
-    "hist_cell_ok", "split_lane_chunk_features",
+    "hist_cell_ok", "hist_fold_cell_ok", "split_lane_chunk_features",
     "split_scan_chunk_features",
 )
 

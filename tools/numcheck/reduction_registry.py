@@ -79,6 +79,21 @@ CONTEXTS = (
             "partitioned (rows shard, classes replicate), and the "
             "importance only ranks rows — order is partition-"
             "independent"},
+    {"function": "make_hist_fold_fn",
+     "module": "lightgbm_tpu/learner/serial.py",
+     "why": "accumulator-SEEDED streamed kernel folds (ISSUE 20): each "
+            "block's kernel call seeds its output from the carry via "
+            "input_output_aliases, replaying the monolithic kernel's "
+            "adds in the monolithic order — exact int32 on quantized "
+            "modes, identical per-tile f32 add sequence on the wide "
+            "float modes (float compact degrades to wide); pinned "
+            "streamed==resident per backend by tests/test_streaming.py"},
+    {"function": "_fold_scales",
+     "module": "lightgbm_tpu/boosting/streaming.py",
+     "why": "per-(tree, shard) quantization scales as a chunked host "
+            "absmax: f32 max/abs are exact and order-independent "
+            "(idempotent commutative max, no rounding), so the chunked "
+            "host reduction equals the device max(|x|) bitwise"},
 )
 
 # the explicit cross-device combine seam: psum/all-reduce of per-shard

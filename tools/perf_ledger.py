@@ -63,6 +63,9 @@ TRACKED: Tuple[Tuple[str, str], ...] = (
     # derived from the leg's per-mesh-size multichip_table
     ("multichip_row_iters_per_sec", "mc r-it/s"),
     ("multichip_fused_speedup", "mc fused x"),
+    # streamed out-of-core training at kernel speed (ISSUE 20): the
+    # scale-phase streamed rows/s from the stream_ingest leg
+    ("stream_rows_per_sec", "stream rows/s"),
 )
 ATTRIBUTION_KEYS = ("attribution_device_frac", "attribution_host_gap_frac",
                     "attribution_collective_frac")
